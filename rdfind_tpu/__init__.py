@@ -1,0 +1,19 @@
+"""tpu-cind: a TPU-native framework for Conditional Inclusion Dependency discovery in RDF.
+
+Re-implements the capabilities of stratosphere/rdfind (SIGMOD 2016) from scratch on
+JAX/XLA/Pallas.  See SURVEY.md at the repo root for the structural analysis of the
+reference that this build follows.
+
+Package layout:
+  conditions   -- the 6-bit capture-code algebra (reference: util/ConditionCodes.scala)
+  data         -- table dataclasses (triples, captures, CINDs)
+  dictionary   -- host-side string interning (replaces hash-dictionary compression)
+  io/          -- N-Triples/N-Quads parsing, multi-file gz-aware reading, prefixes
+  ops/         -- device primitives: segments, hashing, pair emission, sketches
+  parallel/    -- mesh + collective bucket-exchange layer (shard_map/all_to_all)
+  models/      -- the four traversal strategies (all-at-once, small-to-large, approx)
+  runtime/     -- end-to-end drivers, CLI parameter surface
+  utils/       -- host-side helpers (sorted-set algebra, trie)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,91 @@
+"""Table-oriented data model.
+
+Where the reference uses per-record case classes streamed through Flink operators
+(rdfind-algorithm/.../data/*.scala), the TPU build is table-oriented: everything is a
+struct-of-arrays of int32 columns so it can live in HBM and feed the MXU.  Strings are
+interned once on the host (see dictionary.py); `-1` is the sentinel for "no value"
+(the reference's null/""), e.g. the second condition value of a unary capture.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from . import conditions as cc
+
+NO_VALUE = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class Cind:
+    """One conditional inclusion dependency: dep ⊆ ref with |dep| = support.
+
+    Reference: data/Cind.scala:12-57 (values here are interned ids or strings).
+    """
+
+    dep_code: int
+    dep_v1: object
+    dep_v2: object
+    ref_code: int
+    ref_v1: object
+    ref_v2: object
+    support: int
+
+    def pretty(self) -> str:
+        dep = cc.pretty(self.dep_code, self.dep_v1, self.dep_v2)
+        ref = cc.pretty(self.ref_code, self.ref_v1, self.ref_v2)
+        return f"{dep} < {ref} ({self.support})"
+
+
+@dataclasses.dataclass
+class CindTable:
+    """Columnar CIND set: 7 aligned int32/int64 columns."""
+
+    dep_code: np.ndarray
+    dep_v1: np.ndarray
+    dep_v2: np.ndarray
+    ref_code: np.ndarray
+    ref_v1: np.ndarray
+    ref_v2: np.ndarray
+    support: np.ndarray
+
+    def __len__(self) -> int:
+        return len(self.dep_code)
+
+    @staticmethod
+    def empty() -> "CindTable":
+        z = np.zeros(0, np.int64)
+        return CindTable(z, z, z, z, z, z, z)
+
+    @staticmethod
+    def from_rows(rows) -> "CindTable":
+        """rows: iterable of 7-tuples (dep_code, dep_v1, dep_v2, ref_code, ref_v1, ref_v2, support)."""
+        arr = np.asarray(sorted(rows), dtype=np.int64).reshape(-1, 7)
+        return CindTable(*(arr[:, i] for i in range(7)))
+
+    def to_rows(self):
+        """Set of 7-tuples, canonical for equality testing."""
+        return {
+            (int(a), int(b), int(c), int(d), int(e), int(f), int(g))
+            for a, b, c, d, e, f, g in zip(
+                self.dep_code, self.dep_v1, self.dep_v2,
+                self.ref_code, self.ref_v1, self.ref_v2, self.support,
+            )
+        }
+
+    def decoded(self, dictionary) -> list[Cind]:
+        """Resolve interned ids back to strings via `dictionary` (see dictionary.py)."""
+
+        def dec(v):
+            v = int(v)
+            return None if v == NO_VALUE else dictionary.value(v)
+
+        return [
+            Cind(int(dc), dec(d1), dec(d2), int(rc), dec(r1), dec(r2), int(s))
+            for dc, d1, d2, rc, r1, r2, s in zip(
+                self.dep_code, self.dep_v1, self.dep_v2,
+                self.ref_code, self.ref_v1, self.ref_v2, self.support,
+            )
+        ]

@@ -1,0 +1,17 @@
+"""Test harness: force an 8-device CPU mesh before jax is imported.
+
+This plays the role of the reference's embedded Flink minicluster
+(StratosphereParameters.java:75-94) — multi-device behavior is exercised on one host.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)

@@ -1,0 +1,125 @@
+"""Property tests for the capture-code algebra.
+
+Mirrors (and extends) the reference's exhaustive enumeration test
+(rdfind-algorithm/src/test/scala/.../ConditionCodes$Test.scala:10-34): every property
+is checked against an independent Python-set oracle over all 256 codes, both
+scalar-wise and vectorized over numpy arrays.
+"""
+
+import numpy as np
+import pytest
+
+from rdfind_tpu import conditions as cc
+
+
+def bits(x):
+    return {b for b in (1, 2, 4) if x & b}
+
+
+ALL_CODES = list(range(256))
+
+
+def test_classification_exhaustive():
+    for code in ALL_CODES:
+        n = len(bits(code & 7))
+        assert bool(cc.is_unary(code)) == (n == 1), code
+        assert bool(cc.is_binary(code)) == (n == 2), code
+
+
+def test_valid_standard_captures_enumeration():
+    # Oracle: 1-2 primary bits, exactly 1 secondary bit, disjoint, fits in 6 bits.
+    expected = set()
+    for code in range(64):
+        prim, sec = bits(code & 7), bits((code >> 3) & 7)
+        if 1 <= len(prim) <= 2 and len(sec) == 1 and not (prim & sec):
+            expected.add(code)
+    got = {code for code in ALL_CODES if cc.is_valid_standard_capture(code)}
+    assert got == expected
+    # 3 projections x 2 unary conditions + 3 projections x 1 binary condition = 9
+    assert len([c for c in got if cc.is_unary(c)]) == 6
+    assert len([c for c in got if cc.is_binary(c)]) == 3
+    assert got == set(cc.ALL_VALID_CAPTURE_CODES)
+
+
+def test_add_secondary_conditions():
+    for code in range(8):
+        out = cc.add_secondary(code)
+        assert bits(out & 7) == bits(code)
+        assert bits((out >> 3) & 7) == bits(7) - bits(code)
+
+
+def test_first_second_secondary():
+    for code in (1, 2, 4, 3, 5, 6):
+        free = sorted(bits(7) - bits(code))
+        first = cc.add_first_secondary(code)
+        assert bits(first & 7) == bits(code)
+        assert bits((first >> 3) & 7) == {free[0]}
+        if len(free) > 1:
+            second = cc.add_second_secondary(code)
+            assert bits((second >> 3) & 7) == {free[1]}
+
+
+def test_decode_round_trip():
+    for code in cc.ALL_VALID_CAPTURE_CODES:
+        first, second, free = cc.decode(code)
+        assert bits(first) | bits(second) == bits(code & 7)
+        assert bits(free) == bits(7) - bits(first) - bits(second)
+        if cc.is_unary(code):
+            assert second == 0
+
+
+def test_subcaptures():
+    for code in cc.ALL_VALID_CAPTURE_CODES:
+        if not cc.is_binary(code):
+            continue
+        f, s = cc.first_subcapture(code), cc.second_subcapture(code)
+        assert cc.is_unary(f) and cc.is_unary(s)
+        # Same projection, condition bits are the two halves in ascending order.
+        assert cc.secondary(f) == cc.secondary(code)
+        assert cc.secondary(s) == cc.secondary(code)
+        assert (f & 7) | (s & 7) == code & 7
+        assert (f & 7) < (s & 7)
+        assert cc.is_subcode(f, code) and cc.is_subcode(s, code)
+
+
+def test_is_subcode():
+    assert cc.is_subcode(1, 3) and cc.is_subcode(2, 3)
+    assert not cc.is_subcode(4, 3)
+    for code in ALL_CODES:
+        assert cc.is_subcode(code, code)
+
+
+def test_vectorized_matches_scalar():
+    codes = np.arange(256, dtype=np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(cc.is_unary(codes)),
+        np.array([bool(cc.is_unary(int(c))) for c in codes]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cc.is_valid_standard_capture(codes)),
+        np.array([bool(cc.is_valid_standard_capture(int(c))) for c in codes]),
+    )
+    bin_codes = np.array([c for c in cc.ALL_VALID_CAPTURE_CODES if cc.is_binary(c)], np.int32)
+    np.testing.assert_array_equal(
+        cc.first_subcapture(bin_codes),
+        np.array([cc.first_subcapture(int(c)) for c in bin_codes]),
+    )
+    np.testing.assert_array_equal(
+        cc.second_subcapture(bin_codes),
+        np.array([cc.second_subcapture(int(c)) for c in bin_codes]),
+    )
+
+
+def test_jax_arrays_work():
+    jnp = pytest.importorskip("jax.numpy")
+    codes = jnp.array(cc.ALL_VALID_CAPTURE_CODES, dtype=jnp.int32)
+    assert int(cc.is_unary(codes).sum()) == 6
+    assert int(cc.is_binary(codes).sum()) == 3
+    assert bool(cc.is_valid_standard_capture(codes).all())
+
+
+def test_pretty_print():
+    code = cc.create(cc.PREDICATE, secondary_condition=cc.OBJECT)
+    assert cc.pretty(code, "birthPlace") == "o[p=birthPlace]"
+    code2 = cc.add_secondary(cc.SUBJECT_PREDICATE)
+    assert cc.pretty(code2, "x", "y") == "o[s=x,p=y]"
