@@ -1,0 +1,59 @@
+"""Host-side string interning: the global value dictionary.
+
+The reference compresses long values with a hash dictionary and escapes collisions
+(operators/CreateHashes.scala, util/HashCollisionHandler.scala:11-42, plus the
+CheckHashCollisions oracle program).  The TPU build instead interns every string
+exactly once into dense int32 ids — exact (no collision handling needed, subsuming
+CreateHashes/CombineHashes/ConditionCompressor/ConditionDecompressor) and the natural
+device representation: all downstream compute is on int32 tables.
+
+One dictionary spans all three triple fields, because join lines group captures by
+shared *value* across fields (RDFind.scala:332-346 groups JoinCandidates by the raw
+string join value).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Dictionary:
+    """Sorted unique values; id = rank in sorted order."""
+
+    values: np.ndarray  # sorted 1-D array of str/bytes
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def value(self, idx: int):
+        return self.values[idx]
+
+    def id(self, value) -> int:
+        """Exact lookup; raises KeyError for unknown values."""
+        pos = int(np.searchsorted(self.values, value))
+        if pos >= len(self.values) or self.values[pos] != value:
+            raise KeyError(value)
+        return pos
+
+    def ids(self, values) -> np.ndarray:
+        values = np.asarray(values)
+        pos = np.searchsorted(self.values, values)
+        pos_clip = np.minimum(pos, len(self.values) - 1)
+        if not np.all(self.values[pos_clip] == values):
+            raise KeyError("unknown value(s) in lookup")
+        return pos_clip.astype(np.int32)
+
+
+def intern_triples(triples) -> tuple[np.ndarray, Dictionary]:
+    """Intern an iterable/array of (s, p, o) values into an (N, 3) int32 id table."""
+    arr = np.asarray(triples)
+    if arr.ndim != 2 or arr.shape[1] != 3:
+        raise ValueError(f"expected (N, 3) triples, got shape {arr.shape}")
+    uniques, inverse = np.unique(arr.reshape(-1), return_inverse=True)
+    if len(uniques) >= np.iinfo(np.int32).max:
+        raise ValueError("dictionary exceeds int32 id space")
+    ids = inverse.reshape(arr.shape).astype(np.int32)
+    return ids, Dictionary(uniques)

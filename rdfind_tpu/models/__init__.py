@@ -1,0 +1,7 @@
+"""Traversal strategies — the model families of the framework.
+
+Mirrors the reference's plan/ package: AllAtOnce (strategy 0), SmallToLarge
+(strategy 1, default there), and the approximate two-round variants (2, 3).  All
+strategies must produce identical CIND sets; they differ in how much intermediate
+state they materialize.
+"""

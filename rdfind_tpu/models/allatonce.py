@@ -1,0 +1,279 @@
+"""AllAtOnce traversal strategy on a single device.
+
+One pass over the data: emit join candidates, group into join lines, emit all
+co-occurrence pairs, count, and read CINDs off the counts.  Mirrors the semantics of
+the reference's AllAtOnceTraversalStrategy (plan/AllAtOnceTraversalStrategy.scala:
+33-85) with the intersection of evidence refsets replaced by the equivalent
+co-occurrence count test (see ops/pairs.py).
+
+Built-in exact pruning that the reference approximates with Bloom filters:
+  * frequent-condition prefilter at emission (ops/frequency.py);
+  * frequent-*capture* filter before pair emission — a capture with fewer than
+    min_support distinct join values can appear in no CIND, on either side (the
+    reference's --find-frequent-captures path, RDFind.scala:348-400, optional and
+    approximate there; exact and always-on here).
+
+Execution model (the TPU-shaped part): the pipeline is jitted fixed-shape stages
+with validity masks.  The host reads a few scalars between stages and pads the next
+stage's inputs to a power-of-two capacity, so compiled programs are reused across
+datasets and chunk sizes; there is no data-dependent shape inside any stage.
+
+Pair emission is *chunked*: join lines are greedily packed into chunks of at most
+PAIR_CHUNK_BUDGET pairs (whole lines stay together), each chunk produces partial
+(dep, ref, count) rows, and a final merge stage sums counts across chunks before the
+CIND test.  This bounds peak memory on skewed data (quadratic pair counts overflow
+int32 and HBM alike), replaces the reference's windowed BulkMergeDependencies
+backpressure (candidate_merging/BulkMergeDependencies.scala:48-165), and is the same
+merge shape the multi-chip path uses across devices.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import conditions as cc
+from .. import oracle
+from ..data import CindTable
+from ..ops import frequency, pairs, segments
+from ..ops.emission import emit_join_candidates
+
+SENTINEL = segments.SENTINEL
+
+# Max co-occurrence pairs materialized per chunk (before dedup); 2^22 rows ~= 100 MB
+# of intermediate sort state -- far below HBM, large enough to keep the MXU-era
+# pipeline busy.  A single line larger than the budget still gets its own chunk.
+PAIR_CHUNK_BUDGET = 1 << 22
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
+
+
+def _pad_np(arr: np.ndarray, capacity: int, fill) -> np.ndarray:
+    if arr.shape[0] >= capacity:
+        return arr[:capacity]
+    return np.concatenate([arr, np.full(capacity - arr.shape[0], fill, arr.dtype)])
+
+
+@functools.partial(jax.jit, static_argnames=("projections", "use_fc_filter"))
+def _stage_candidates(triples, n_valid, min_support, *, projections, use_fc_filter):
+    """Triples -> deduped join-line rows (sorted by (value, capture)) + capture table.
+
+    Returns (line_val, line_cap, n_rows, cap_code, cap_v1, cap_v2, num_caps); all
+    arrays have capacity 3*|projections|*N with valid data compacted to the front.
+    """
+    n = triples.shape[0]
+    valid_t = jnp.arange(n, dtype=jnp.int32) < n_valid
+    freq = (frequency.triple_frequencies(triples, valid_t, min_support)
+            if use_fc_filter else frequency.no_filter(valid_t))
+    cands = emit_join_candidates(triples, freq, projections)
+
+    # Intern captures: (code, v1, v2) -> dense capture id; table in canonical
+    # (code, v1, v2) sorted order, matching the reference's Condition.compare.
+    (cap_cols, _, cap_id, num_caps) = segments.masked_unique(
+        [cands.code, cands.v1, cands.v2], cands.valid)
+
+    # Join lines: distinct (join value, capture) occurrences, sorted by value.
+    cap_id_keyed = jnp.where(cands.valid, cap_id, SENTINEL)
+    (line_cols, _, _, n_rows) = segments.masked_unique(
+        [cands.join_val, cap_id_keyed], cands.valid)
+
+    return (line_cols[0], line_cols[1], n_rows,
+            cap_cols[0], cap_cols[1], cap_cols[2], num_caps)
+
+
+@jax.jit
+def _stage_capture_filter(line_val, line_cap, n_rows, min_support):
+    """Exact capture support + frequent-capture pruning.
+
+    dep_count[c] = number of distinct join values containing capture c (= |c|, the
+    capture's true size).  Keeps only rows whose capture is frequent; order stays
+    (value, capture) sorted.
+    """
+    n = line_val.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < n_rows
+    caps = jnp.where(valid, line_cap, 0)
+    dep_count = jax.ops.segment_sum(valid.astype(jnp.int32), caps, num_segments=n)
+    keep = valid & (dep_count[caps] >= min_support)
+    (out_val, out_cap), n_keep = segments.compact([line_val, line_cap], keep)
+    return out_val, out_cap, n_keep, dep_count
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def _stage_pair_counts(line_cap, pos, length, start_idx, *, capacity):
+    """One chunk: emit pairs, dedupe, count.  Returns (dep, ref, cnt, n_pairs)
+    compacted to the front (cnt = co-occurrence count within this chunk)."""
+    dep, ref, pair_valid = pairs.emit_pairs(line_cap, pos, length, start_idx, capacity)
+    perm = segments.lexsort([dep, ref])
+    ds, rs, vs = dep[perm], ref[perm], pair_valid[perm]
+    starts = segments.run_starts([ds, rs]) & vs
+    gid = jnp.cumsum(starts).astype(jnp.int32) - 1
+    cnt = jax.ops.segment_sum(vs.astype(jnp.int32), gid, num_segments=capacity)[gid]
+    (d_out, r_out, c_out), n_out = segments.compact([ds, rs, cnt], starts)
+    return d_out, r_out, c_out, n_out
+
+
+@jax.jit
+def _stage_merge(dep, ref, cnt, n_valid, min_support, dep_count,
+                 cap_code, cap_v1, cap_v2):
+    """Merge per-chunk pair counts, apply the CIND test, drop implied pairs.
+
+    Returns (dep_id, ref_id, support, n_cinds) compacted to the front.
+    """
+    m = dep.shape[0]
+    valid = jnp.arange(m, dtype=jnp.int32) < n_valid
+    dep = jnp.where(valid, dep, SENTINEL)
+    ref = jnp.where(valid, ref, SENTINEL)
+    perm = segments.lexsort([dep, ref])
+    ds, rs, vs = dep[perm], ref[perm], valid[perm]
+    cs = jnp.where(vs, cnt[perm], 0)
+    starts = segments.run_starts([ds, rs]) & vs
+    gid = jnp.cumsum(starts).astype(jnp.int32) - 1
+    cooc = jax.ops.segment_sum(cs, gid, num_segments=m)[gid]
+
+    nc = cap_code.shape[0]
+    d_safe = jnp.clip(ds, 0, nc - 1)
+    r_safe = jnp.clip(rs, 0, nc - 1)
+    support = dep_count[jnp.clip(ds, 0, dep_count.shape[0] - 1)]
+    is_cind = (cooc == support) & (support >= min_support)
+
+    # Trivially implied pairs (data/Condition.scala:35-43 semantics, including the
+    # equal-code quirk pinned in tests/test_oracle.py).
+    d_code, r_code = cap_code[d_safe], cap_code[r_safe]
+    implied = cc.is_subcode(r_code, d_code) & jnp.where(
+        cc.first_subcapture(d_code) == r_code,
+        cap_v1[r_safe] == cap_v1[d_safe],
+        cap_v1[r_safe] == cap_v2[d_safe])
+
+    keep = starts & is_cind & ~implied
+    (d_out, r_out, s_out), n_out = segments.compact([ds, rs, support], keep)
+    return d_out, r_out, s_out, n_out
+
+
+def _chunk_boundaries(pairs_per_line: np.ndarray, budget: int) -> list[int]:
+    """Greedy packing of whole lines into chunks of <= budget pairs each.
+
+    Returns line-index boundaries [0, ..., num_lines]; a single line over budget
+    gets its own chunk.
+    """
+    bounds = [0]
+    acc = 0
+    for i, p in enumerate(pairs_per_line):
+        if acc > 0 and acc + p > budget:
+            bounds.append(i)
+            acc = 0
+        acc += int(p)
+    bounds.append(len(pairs_per_line))
+    return bounds
+
+
+def discover(triples, min_support: int, projections: str = "spo",
+             use_frequent_condition_filter: bool = True,
+             clean_implied: bool = False,
+             pair_chunk_budget: int = PAIR_CHUNK_BUDGET) -> CindTable:
+    """Discover all CINDs in an (N, 3) int32 triple-id table."""
+    triples = np.asarray(triples, np.int32)
+    n = triples.shape[0]
+    if n == 0 or not any(ch in projections for ch in "spo"):
+        return CindTable.empty()
+    min_support = max(int(min_support), 1)
+
+    cap_n = _pow2(n)
+    padded = jnp.asarray(np.pad(triples, ((0, cap_n - n), (0, 0)),
+                                constant_values=np.iinfo(np.int32).max))
+    (line_val, line_cap, n_rows, cap_code, cap_v1, cap_v2, num_caps) = \
+        _stage_candidates(padded, jnp.int32(n), jnp.int32(min_support),
+                          projections=projections,
+                          use_fc_filter=use_frequent_condition_filter)
+    n_rows = int(n_rows)
+    if n_rows == 0:
+        return CindTable.empty()
+
+    cap_l = _pow2(n_rows)
+    line_val, line_cap, n_keep, dep_count = _stage_capture_filter(
+        jnp.asarray(_pad_np(np.asarray(line_val), cap_l, SENTINEL)),
+        jnp.asarray(_pad_np(np.asarray(line_cap), cap_l, SENTINEL)),
+        jnp.int32(n_rows), jnp.int32(min_support))
+    n_keep = int(n_keep)
+    if n_keep == 0:
+        return CindTable.empty()
+
+    # Host-side line layout (int64-safe) + greedy chunking over whole lines.
+    line_val_h = np.asarray(line_val)[:n_keep]
+    line_cap_h = np.asarray(line_cap)[:n_keep]
+    starts_h = np.empty(n_keep, bool)
+    starts_h[0] = True
+    starts_h[1:] = line_val_h[1:] != line_val_h[:-1]
+    line_start_rows = np.flatnonzero(starts_h)
+    line_lens = np.diff(np.append(line_start_rows, n_keep)).astype(np.int64)
+    pairs_per_line = line_lens * (line_lens - 1)
+    if int(pairs_per_line.sum()) == 0:
+        return CindTable.empty()
+    pos_h = (np.arange(n_keep, dtype=np.int64)
+             - np.repeat(line_start_rows, line_lens)).astype(np.int32)
+    len_h = np.repeat(line_lens, line_lens).astype(np.int32)
+
+    bounds = _chunk_boundaries(pairs_per_line, pair_chunk_budget)
+    parts_d, parts_r, parts_c = [], [], []
+    for bi in range(len(bounds) - 1):
+        lo_line, hi_line = bounds[bi], bounds[bi + 1]
+        if lo_line == hi_line:
+            continue
+        rs = int(line_start_rows[lo_line])
+        re = int(line_start_rows[hi_line]) if hi_line < len(line_start_rows) else n_keep
+        chunk_pairs = int(pairs_per_line[lo_line:hi_line].sum())
+        if chunk_pairs == 0:
+            continue
+        row_cap = _pow2(re - rs)
+        pair_cap = _pow2(chunk_pairs)
+        d, r, c, n_out = _stage_pair_counts(
+            jnp.asarray(_pad_np(line_cap_h[rs:re], row_cap, SENTINEL)),
+            jnp.asarray(_pad_np(pos_h[rs:re], row_cap, 0)),
+            jnp.asarray(_pad_np(len_h[rs:re], row_cap, 1)),
+            jnp.asarray(_pad_np(
+                (np.arange(rs, re, dtype=np.int32) - pos_h[rs:re]) - rs, row_cap, 0)),
+            capacity=pair_cap)
+        n_out = int(n_out)
+        parts_d.append(np.asarray(d)[:n_out])
+        parts_r.append(np.asarray(r)[:n_out])
+        parts_c.append(np.asarray(c)[:n_out])
+
+    all_d = np.concatenate(parts_d) if parts_d else np.zeros(0, np.int32)
+    if all_d.shape[0] == 0:
+        return CindTable.empty()
+    all_r = np.concatenate(parts_r)
+    all_c = np.concatenate(parts_c)
+    cap_m = _pow2(all_d.shape[0])
+    d_out, r_out, s_out, n_out = _stage_merge(
+        jnp.asarray(_pad_np(all_d, cap_m, SENTINEL)),
+        jnp.asarray(_pad_np(all_r, cap_m, SENTINEL)),
+        jnp.asarray(_pad_np(all_c, cap_m, 0)),
+        jnp.int32(all_d.shape[0]), jnp.int32(min_support), dep_count,
+        cap_code, cap_v1, cap_v2)
+    n_out = int(n_out)
+    if n_out == 0:
+        return CindTable.empty()
+
+    dep_id = np.asarray(d_out[:n_out])
+    ref_id = np.asarray(r_out[:n_out])
+    support = np.asarray(s_out[:n_out])
+    num_caps = int(num_caps)
+    cap_code = np.asarray(cap_code[:num_caps])
+    cap_v1 = np.asarray(cap_v1[:num_caps])
+    cap_v2 = np.asarray(cap_v2[:num_caps])
+    table = CindTable(
+        dep_code=cap_code[dep_id].astype(np.int64),
+        dep_v1=cap_v1[dep_id].astype(np.int64),
+        dep_v2=cap_v2[dep_id].astype(np.int64),
+        ref_code=cap_code[ref_id].astype(np.int64),
+        ref_v1=cap_v1[ref_id].astype(np.int64),
+        ref_v2=cap_v2[ref_id].astype(np.int64),
+        support=support.astype(np.int64),
+    )
+    if clean_implied:
+        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+    return table

@@ -1,0 +1,3 @@
+"""Device primitives: segment algebra, join-candidate emission, pair generation,
+bitset sketches.  Everything is int32 struct-of-arrays; nothing here touches strings.
+"""

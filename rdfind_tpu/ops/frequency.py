@@ -1,0 +1,60 @@
+"""Frequent-condition mining as segment counting.
+
+Replaces the reference's FrequentConditionPlanner count pipelines
+(plan/FrequentConditionPlanner.scala:291-311 for unary, :374-394 for binary): a
+condition (field=value, or field-pair=value-pair) is *frequent* when at least
+``min_support`` triples satisfy it.  Frequency here is a conservative prefilter — a
+capture can never be larger than its condition's triple count — so pruning on it
+never changes the final CIND set (the exact support test happens downstream).
+
+Instead of Bloom filters broadcast to workers, counts are computed exactly via
+group-by-and-count and mapped straight back onto the triple rows that asked — the
+query set and the count set are the same rows, so membership testing disappears.
+
+Fixed-shape and jittable: `valid` masks padding rows, which always count as 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from . import segments
+
+_FIELD_PAIRS = ((0, 1), (0, 2), (1, 2))  # (s,p), (s,o), (p,o) in ascending bit order
+
+
+@dataclasses.dataclass
+class TripleFrequency:
+    """Per-triple-row frequency verdicts.
+
+    unary_ok[i, f]   -- field f's value in row i occurs >= min_support times in f;
+    binary_ok[i, k]  -- row i's value pair for field-pair k occurs >= min_support
+                        times (k indexes _FIELD_PAIRS).
+    """
+
+    unary_ok: jnp.ndarray  # (N, 3) bool
+    binary_ok: jnp.ndarray  # (N, 3) bool
+
+
+def triple_frequencies(triples, valid, min_support) -> TripleFrequency:
+    """Exact unary + binary condition frequencies, evaluated on the triples' own rows."""
+    unary_ok = [
+        segments.masked_row_counts([triples[:, f]], valid) >= min_support
+        for f in range(3)
+    ]
+    binary_ok = [
+        segments.masked_row_counts([triples[:, a], triples[:, b]], valid) >= min_support
+        for a, b in _FIELD_PAIRS
+    ]
+    return TripleFrequency(
+        unary_ok=jnp.stack(unary_ok, axis=1),
+        binary_ok=jnp.stack(binary_ok, axis=1),
+    )
+
+
+def no_filter(valid) -> TripleFrequency:
+    """All-pass verdicts for valid rows (the --no-frequent-item-set path)."""
+    ok = jnp.tile(valid[:, None], (1, 3))
+    return TripleFrequency(ok, ok)

@@ -1,0 +1,74 @@
+"""CIND-evidence pair generation — the quadratic hot path, as rotations.
+
+The reference emits, per join line, one evidence per dependent capture carrying the
+whole line as referenced set (CreateAllCindCandidates.scala:106-121) and k-way
+intersects them (IntersectCindCandidates.scala:14-51).  Equivalent count formulation
+used here: for captures d, r
+
+    CIND d ⊆ r  <=>  cooc(d, r) == |lines containing d|  (and support >= min_support)
+
+so evidence extraction becomes emitting all ordered co-occurrence pairs and counting.
+
+Pair enumeration is rotation-based: for a line of length L laid out contiguously,
+rotation j (1 <= j < L) pairs each element with the one j slots ahead (mod L).  The
+whole enumeration is one flat repeat + gather with a *static* output capacity — a
+constant number of XLA ops, fully jittable, however skewed the line-size distribution
+is.  Total real work is sum_l L_l (L_l - 1), the evidence count itself.
+
+All functions here are fixed-shape and mask-based (see ops/segments.py conventions):
+rows beyond the valid count are garbage and must be masked by callers.
+"""
+
+from __future__ import annotations
+
+import jax.lax
+import jax.numpy as jnp
+import jax.ops
+
+from . import segments
+
+
+def line_layout(line_val, n_valid):
+    """Run layout over candidate rows sorted by join value, valid-prefix masked.
+
+    `line_val` must be sorted ascending among its first `n_valid` rows (rows beyond
+    are garbage).  Returns (pos, length, start_idx, total_pairs):
+      pos       -- position of each row within its line;
+      length    -- line length (1 for invalid rows, so they contribute no pairs);
+      start_idx -- index of the line's first row;
+      total_pairs -- scalar, sum of length*(length-1) over lines.
+    """
+    n = line_val.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    valid = idx < n_valid
+    jv = jnp.where(valid, line_val, segments.SENTINEL)
+    starts = segments.run_starts([jv])
+    gid = jnp.cumsum(starts).astype(jnp.int32) - 1
+    counts = jax.ops.segment_sum(valid.astype(jnp.int32), gid, num_segments=n)
+    length = jnp.where(valid, counts[gid], 1)
+    run_start = jax.lax.cummax(jnp.where(starts, idx, 0))
+    pos = idx - run_start
+    total_pairs = (length - 1).sum()
+    return pos, length, run_start, total_pairs
+
+
+def emit_pairs(line_cap, pos, length, start_idx, capacity: int):
+    """All ordered (dep, ref) co-occurrence pairs, padded to a static capacity.
+
+    Returns (dep, ref, pair_valid).  Rows beyond the true total carry SENTINEL keys.
+    `capacity` must be >= total_pairs (callers size it from line_layout's total).
+    """
+    n = line_cap.shape[0]
+    reps = length - 1
+    total = reps.sum()
+    row = jnp.repeat(jnp.arange(n, dtype=jnp.int32), reps, total_repeat_length=capacity)
+    block_start = jnp.repeat(jnp.cumsum(reps).astype(jnp.int32) - reps, reps,
+                             total_repeat_length=capacity)
+    out_idx = jnp.arange(capacity, dtype=jnp.int32)
+    pair_valid = out_idx < total
+    j = out_idx - block_start + 1
+    partner = start_idx[row] + (pos[row] + j) % length[row]
+    partner = jnp.clip(partner, 0, n - 1)  # tail rows repeat the last real row; masked
+    dep = jnp.where(pair_valid, line_cap[row], segments.SENTINEL)
+    ref = jnp.where(pair_valid, line_cap[partner], segments.SENTINEL)
+    return dep, ref, pair_valid

@@ -1,0 +1,91 @@
+"""Sorted-run / segment algebra — the TPU-side replacement for hash shuffles.
+
+Every Flink ``groupBy`` in the reference plan (RDFind.scala:332-346,
+AllAtOnceTraversalStrategy.scala:60-68) becomes: lexicographic sort of int32 key
+columns + run detection + segment reduction.  All indices stay int32 (no x64 needed),
+shapes stay static per input size, and the sorts map onto XLA's TPU sort.
+"""
+
+from __future__ import annotations
+
+import jax.lax
+import jax.numpy as jnp
+
+
+def lexsort(cols):
+    """Permutation sorting rows by the given key columns (first column = major key).
+
+    `cols` is a sequence of equal-length 1-D arrays.  Returns int32 indices.
+    jnp.lexsort takes the *last* key as primary, so reverse here.
+    """
+    return jnp.lexsort(tuple(reversed(tuple(cols))))
+
+
+def run_starts(sorted_cols):
+    """Boolean mask marking the first row of each distinct-key run in sorted rows."""
+    n = sorted_cols[0].shape[0]
+    if n == 0:
+        return jnp.zeros(0, bool)
+    neq = jnp.zeros(n - 1, bool)
+    for c in sorted_cols:
+        neq = neq | (c[1:] != c[:-1])
+    return jnp.concatenate([jnp.ones(1, bool), neq])
+
+
+# ---------------------------------------------------------------------------
+# Jit-safe (fixed-shape, mask-based) variants.  Convention: invalid rows carry
+# SENTINEL in every key column, so they sort to the end and form one garbage run.
+# ---------------------------------------------------------------------------
+
+SENTINEL = jnp.iinfo(jnp.int32).max
+
+
+def masked_row_counts(cols, valid):
+    """For each row, how many valid rows share its key.  Fixed-shape, jittable.
+
+    Invalid rows get count 0.
+    """
+    n = cols[0].shape[0]
+    cols = [jnp.where(valid, c, SENTINEL) for c in cols]
+    perm = lexsort(cols)
+    sorted_cols = [c[perm] for c in cols]
+    v_sorted = valid[perm].astype(jnp.int32)
+    gid = jnp.cumsum(run_starts(sorted_cols)).astype(jnp.int32) - 1
+    counts = jax.ops.segment_sum(v_sorted, gid, num_segments=n)
+    per_row_sorted = counts[gid] * v_sorted
+    return jnp.zeros(n, jnp.int32).at[perm].set(per_row_sorted)
+
+
+def masked_unique(cols, valid):
+    """Distinct valid rows, compacted to the front in sorted key order.
+
+    Returns (out_cols, out_valid, inverse, n_unique):
+      out_cols  -- fixed-shape columns; rows [0, n_unique) are the distinct keys in
+                   ascending order, the rest is garbage;
+      inverse   -- for each input row, the dense id of its key (garbage for invalid
+                   rows);
+      n_unique  -- scalar array, number of distinct valid keys.
+    """
+    n = cols[0].shape[0]
+    cols = [jnp.where(valid, c, SENTINEL) for c in cols]
+    perm = lexsort(cols)
+    sorted_cols = [c[perm] for c in cols]
+    v_sorted = valid[perm]
+    is_new = run_starts(sorted_cols) & v_sorted
+    gid = jnp.cumsum(is_new).astype(jnp.int32) - 1  # valid rows only; garbage run inherits last id
+    n_unique = is_new.sum().astype(jnp.int32)
+    inverse = jnp.zeros(n, jnp.int32).at[perm].set(gid)
+    # Compact distinct rows to the front, preserving sorted order (stable sort on ~is_new).
+    order = jnp.argsort(~is_new, stable=True)
+    out_cols = [c[order] for c in sorted_cols]
+    out_valid = jnp.arange(n, dtype=jnp.int32) < n_unique
+    return out_cols, out_valid, inverse, n_unique
+
+
+def compact(cols, keep):
+    """Move rows with keep=True to the front (preserving order).  Jittable.
+
+    Returns (out_cols, n_kept).
+    """
+    order = jnp.argsort(~keep, stable=True)
+    return [c[order] for c in cols], keep.sum().astype(jnp.int32)

@@ -15,3 +15,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
+
+# The axon sitecustomize force-sets jax_platforms="axon,cpu" at interpreter start,
+# which routes every eager op through the remote-TPU tunnel.  Tests must run on the
+# local CPU backend (with the 8 fake devices from XLA_FLAGS above), so override the
+# config again here — conftest runs before any test imports jax.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
