@@ -1,0 +1,66 @@
+"""Benchmark: CIND-candidate-pairs checked per second per chip.
+
+Workload: synthetic RDF (LUBM/DBpedia-shaped, utils/synth.py), full AllAtOnce
+discovery incl. binary captures at min_support=10 — BASELINE.md config-1 analog.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline is measured in-process against the single-core pure-Python oracle
+(rdfind_tpu.oracle.discover_cinds_joinline) on a subsample, scaled to pairs/sec —
+the honest stand-in for the reference's single-worker throughput, since the repo
+ships no Flink cluster numbers (BASELINE.md: "published: none in repo").
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    n = int(os.environ.get("BENCH_TRIPLES", 200_000))
+    min_support = int(os.environ.get("BENCH_MIN_SUPPORT", 10))
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from rdfind_tpu import oracle
+    from rdfind_tpu.models import allatonce
+    from rdfind_tpu.utils.synth import generate_triples
+
+    triples = generate_triples(n, seed=42)
+
+    # Warm-up (compile cache) on the same shapes, then measure.
+    stats = {}
+    allatonce.discover(triples, min_support, stats=stats)
+    t0 = time.perf_counter()
+    table = allatonce.discover(triples, min_support, stats=stats)
+    elapsed = time.perf_counter() - t0
+    pairs_per_sec = stats["total_pairs"] / elapsed
+
+    # Oracle baseline on a subsample (python dict-of-sets single core).
+    n_sub = min(n, 20_000)
+    sub = triples[:n_sub]
+    sub_t = [tuple(int(x) for x in row) for row in sub]
+    t0 = time.perf_counter()
+    oracle.discover_cinds_joinline(sub_t, min_support)
+    oracle_elapsed = time.perf_counter() - t0
+    sub_stats = {}
+    allatonce.discover(sub, min_support, stats=sub_stats)
+    oracle_pairs_per_sec = sub_stats["total_pairs"] / oracle_elapsed
+
+    print(json.dumps({
+        "metric": "cind_pairs_checked_per_sec_per_chip",
+        "value": round(pairs_per_sec, 1),
+        "unit": "pairs/s",
+        "vs_baseline": round(pairs_per_sec / oracle_pairs_per_sec, 3),
+        "detail": {
+            "n_triples": n, "min_support": min_support,
+            "wall_s": round(elapsed, 3), "total_pairs": stats["total_pairs"],
+            "n_lines": stats["n_lines"], "max_line": stats["max_line"],
+            "cinds": len(table),
+            "oracle_pairs_per_sec": round(oracle_pairs_per_sec, 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

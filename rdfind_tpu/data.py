@@ -34,9 +34,11 @@ class Cind:
     support: int
 
     def pretty(self) -> str:
+        """Matches the reference's Cind.toString (data/Cind.scala:29-31)."""
         dep = cc.pretty(self.dep_code, self.dep_v1, self.dep_v2)
         ref = cc.pretty(self.ref_code, self.ref_v1, self.ref_v2)
-        return f"{dep} < {ref} ({self.support})"
+        sup = "unknown support" if self.support == -1 else f"support={self.support}"
+        return f"{dep} < {ref} ({sup})"
 
 
 @dataclasses.dataclass

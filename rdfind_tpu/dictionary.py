@@ -50,6 +50,8 @@ class Dictionary:
 def intern_triples(triples) -> tuple[np.ndarray, Dictionary]:
     """Intern an iterable/array of (s, p, o) values into an (N, 3) int32 id table."""
     arr = np.asarray(triples)
+    if arr.size == 0:
+        return np.zeros((0, 3), np.int32), Dictionary(np.zeros(0, object))
     if arr.ndim != 2 or arr.shape[1] != 3:
         raise ValueError(f"expected (N, 3) triples, got shape {arr.shape}")
     uniques, inverse = np.unique(arr.reshape(-1), return_inverse=True)

@@ -1,0 +1,3 @@
+"""Host ingest: multi-file gz-aware reading, N-Triples/N-Quads parsing, prefix
+shortening.  The analog of rdfind-flink's persistence layer
+(MultiFileTextInputFormat.java:49-368) plus the rdf-converter parsers."""

@@ -1,0 +1,53 @@
+"""Multi-file, gz-aware line reading with file ids and glob resolution.
+
+The analog of MultiFileTextInputFormat (rdfind-flink/.../persistence/
+MultiFileTextInputFormat.java:49-368): many input paths, each line tagged with its
+file id, .gz files transparently decompressed (gz is unsplittable there too,
+:225-230), comment lines (#...) filterable, per-file encodings supported.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import io
+import os
+from collections.abc import Iterator
+
+
+def resolve_path_patterns(patterns) -> list[str]:
+    """Expand globs / directories into a sorted file list (RDFind.resolvePathPatterns)."""
+    out = []
+    for pat in patterns:
+        if os.path.isdir(pat):
+            out.extend(sorted(
+                os.path.join(pat, f) for f in os.listdir(pat)
+                if os.path.isfile(os.path.join(pat, f))))
+        else:
+            matches = sorted(glob.glob(pat))
+            if not matches and os.path.isfile(pat):
+                matches = [pat]
+            if not matches:
+                raise FileNotFoundError(f"no input files match {pat!r}")
+            out.extend(matches)
+    if not out:
+        raise FileNotFoundError("no input files")
+    return out
+
+
+def open_text(path: str, encoding: str = "utf-8"):
+    if path.endswith(".gz"):
+        return io.TextIOWrapper(gzip.open(path, "rb"), encoding=encoding,
+                                errors="replace")
+    return open(path, encoding=encoding, errors="replace")
+
+
+def iter_lines(paths, skip_comments: bool = True,
+               encoding: str = "utf-8") -> Iterator[tuple[int, str]]:
+    """Yield (file_id, line) over all files; comment lines (leading '#') skipped."""
+    for file_id, path in enumerate(paths):
+        with open_text(path, encoding) as f:
+            for line in f:
+                if skip_comments and line.startswith("#"):
+                    continue
+                yield file_id, line.rstrip("\n")

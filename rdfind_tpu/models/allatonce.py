@@ -49,10 +49,6 @@ SENTINEL = segments.SENTINEL
 PAIR_CHUNK_BUDGET = 1 << 22
 
 
-def _pow2(n: int) -> int:
-    return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
-
-
 def _pad_np(arr: np.ndarray, capacity: int, fill) -> np.ndarray:
     if arr.shape[0] >= capacity:
         return arr[:capacity]
@@ -107,7 +103,9 @@ def _stage_capture_filter(line_val, line_cap, n_rows, min_support):
 def _stage_pair_counts(line_cap, pos, length, start_idx, *, capacity):
     """One chunk: emit pairs, dedupe, count.  Returns (dep, ref, cnt, n_pairs)
     compacted to the front (cnt = co-occurrence count within this chunk)."""
-    dep, ref, pair_valid = pairs.emit_pairs(line_cap, pos, length, start_idx, capacity)
+    row, partner, pair_valid = pairs.emit_pair_indices(pos, length, start_idx, capacity)
+    dep = jnp.where(pair_valid, line_cap[row], SENTINEL)
+    ref = jnp.where(pair_valid, line_cap[partner], SENTINEL)
     perm = segments.lexsort([dep, ref])
     ds, rs, vs = dep[perm], ref[perm], pair_valid[perm]
     starts = segments.run_starts([ds, rs]) & vs
@@ -154,6 +152,40 @@ def _stage_merge(dep, ref, cnt, n_valid, min_support, dep_count,
     return d_out, r_out, s_out, n_out
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("projections", "use_fc_filter", "pair_capacity"))
+def fused_step(triples, n_valid, min_support, *, projections="spo",
+               use_fc_filter=True, pair_capacity=1 << 18):
+    """The whole single-device discovery step as ONE jitted program (no host syncs).
+
+    This is the compile-check entry point (__graft_entry__.entry) and the inner body
+    a future scan-over-chunks uses.  `pair_capacity` statically bounds materialized
+    pairs; the returned `overflow` is the number of truncated pairs (callers retry
+    with a larger capacity or fall back to the chunked `discover`).
+
+    Returns (dep_code, dep_v1, dep_v2, ref_code, ref_v1, ref_v2, support, n_cinds,
+    overflow) with CIND rows compacted to the front of capacity-sized arrays.
+    """
+    line_val, line_cap, n_rows, cap_code, cap_v1, cap_v2, _ = _stage_candidates(
+        triples, n_valid, min_support, projections=projections,
+        use_fc_filter=use_fc_filter)
+    line_val, line_cap, n_keep, dep_count = _stage_capture_filter(
+        line_val, line_cap, n_rows, min_support)
+    pos, length, start_idx, total_pairs = pairs.line_layout(line_val, n_keep)
+    overflow = jnp.maximum(total_pairs - pair_capacity, 0)
+    dep, ref, cnt, n_pairs = _stage_pair_counts(
+        line_cap, pos, length, start_idx, capacity=pair_capacity)
+    d_out, r_out, s_out, n_out = _stage_merge(
+        dep, ref, cnt, n_pairs, min_support, dep_count, cap_code, cap_v1, cap_v2)
+    return (cap_code[jnp.clip(d_out, 0, cap_code.shape[0] - 1)],
+            cap_v1[jnp.clip(d_out, 0, cap_v1.shape[0] - 1)],
+            cap_v2[jnp.clip(d_out, 0, cap_v2.shape[0] - 1)],
+            cap_code[jnp.clip(r_out, 0, cap_code.shape[0] - 1)],
+            cap_v1[jnp.clip(r_out, 0, cap_v1.shape[0] - 1)],
+            cap_v2[jnp.clip(r_out, 0, cap_v2.shape[0] - 1)],
+            s_out, n_out, overflow)
+
+
 def _chunk_boundaries(pairs_per_line: np.ndarray, budget: int) -> list[int]:
     """Greedy packing of whole lines into chunks of <= budget pairs each.
 
@@ -174,15 +206,21 @@ def _chunk_boundaries(pairs_per_line: np.ndarray, budget: int) -> list[int]:
 def discover(triples, min_support: int, projections: str = "spo",
              use_frequent_condition_filter: bool = True,
              clean_implied: bool = False,
-             pair_chunk_budget: int = PAIR_CHUNK_BUDGET) -> CindTable:
-    """Discover all CINDs in an (N, 3) int32 triple-id table."""
+             pair_chunk_budget: int = PAIR_CHUNK_BUDGET,
+             stats: dict | None = None) -> CindTable:
+    """Discover all CINDs in an (N, 3) int32 triple-id table.
+
+    If `stats` is a dict, it is filled with pipeline statistics (candidate rows,
+    join lines, total co-occurrence pairs checked, chunks) — the accumulator/counter
+    role of the reference's CountItems operators (operators/CountItems.scala:11-33).
+    """
     triples = np.asarray(triples, np.int32)
     n = triples.shape[0]
     if n == 0 or not any(ch in projections for ch in "spo"):
         return CindTable.empty()
     min_support = max(int(min_support), 1)
 
-    cap_n = _pow2(n)
+    cap_n = segments.pow2_capacity(n)
     padded = jnp.asarray(np.pad(triples, ((0, cap_n - n), (0, 0)),
                                 constant_values=np.iinfo(np.int32).max))
     (line_val, line_cap, n_rows, cap_code, cap_v1, cap_v2, num_caps) = \
@@ -193,7 +231,7 @@ def discover(triples, min_support: int, projections: str = "spo",
     if n_rows == 0:
         return CindTable.empty()
 
-    cap_l = _pow2(n_rows)
+    cap_l = segments.pow2_capacity(n_rows)
     line_val, line_cap, n_keep, dep_count = _stage_capture_filter(
         jnp.asarray(_pad_np(np.asarray(line_val), cap_l, SENTINEL)),
         jnp.asarray(_pad_np(np.asarray(line_cap), cap_l, SENTINEL)),
@@ -211,6 +249,12 @@ def discover(triples, min_support: int, projections: str = "spo",
     line_start_rows = np.flatnonzero(starts_h)
     line_lens = np.diff(np.append(line_start_rows, n_keep)).astype(np.int64)
     pairs_per_line = line_lens * (line_lens - 1)
+    if stats is not None:
+        stats.update(
+            n_triples=n, n_line_rows=n_rows, n_frequent_rows=n_keep,
+            n_lines=int(line_lens.shape[0]), n_captures=int(num_caps),
+            total_pairs=int(pairs_per_line.sum()),
+            max_line=int(line_lens.max()) if line_lens.size else 0)
     if int(pairs_per_line.sum()) == 0:
         return CindTable.empty()
     pos_h = (np.arange(n_keep, dtype=np.int64)
@@ -228,8 +272,8 @@ def discover(triples, min_support: int, projections: str = "spo",
         chunk_pairs = int(pairs_per_line[lo_line:hi_line].sum())
         if chunk_pairs == 0:
             continue
-        row_cap = _pow2(re - rs)
-        pair_cap = _pow2(chunk_pairs)
+        row_cap = segments.pow2_capacity(re - rs)
+        pair_cap = segments.pow2_capacity(chunk_pairs)
         d, r, c, n_out = _stage_pair_counts(
             jnp.asarray(_pad_np(line_cap_h[rs:re], row_cap, SENTINEL)),
             jnp.asarray(_pad_np(pos_h[rs:re], row_cap, 0)),
@@ -247,7 +291,7 @@ def discover(triples, min_support: int, projections: str = "spo",
         return CindTable.empty()
     all_r = np.concatenate(parts_r)
     all_c = np.concatenate(parts_c)
-    cap_m = _pow2(all_d.shape[0])
+    cap_m = segments.pow2_capacity(all_d.shape[0])
     d_out, r_out, s_out, n_out = _stage_merge(
         jnp.asarray(_pad_np(all_d, cap_m, SENTINEL)),
         jnp.asarray(_pad_np(all_r, cap_m, SENTINEL)),

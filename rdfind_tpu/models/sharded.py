@@ -1,0 +1,200 @@
+"""Multi-device AllAtOnce: the full discovery step sharded over a 1-D mesh.
+
+The reference scales by hash-partitioning every operator over Flink task managers
+(SURVEY.md §2h); here the same dataflow runs as ONE jitted shard_map program over a
+jax.sharding.Mesh with three bucket exchanges riding ICI/DCN:
+
+  triples (data-parallel shards)
+    -> emit join candidates, local dedupe            [device-local]
+    -> exchange A: route by hash(join value)         [all_to_all]
+    -> join-line dedupe at the value owner           [device-local]
+    -> exchange B: route (capture, 1) by hash(capture); owner counts support
+    -> pair emission + local pair counts             [device-local, quadratic part]
+    -> exchange C: route pair partials by hash(dependent capture)
+    -> merge counts, sorted-join against support, CIND test   [device-local]
+
+Captures travel as raw (code, v1, v2) key triples — no global capture interning is
+needed, because every grouping is a hash-bucketed sort on the owning device.
+
+Fixed capacities + overflow counters: every exchange and the pair buffer have static
+capacities; overflow is psum-counted and surfaced to the host, which retries with
+doubled capacities (the Flink analog — spill-to-disk — does not exist on TPU).
+
+The frequent-condition/-capture prefilters are not yet applied in this path (they
+are pure pruning, so output is unchanged); they land with the distributed frequency
+pass.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .. import conditions as cc
+from .. import oracle
+from ..data import CindTable
+from ..ops import frequency, hashing, pairs, segments
+from ..ops.emission import emit_join_candidates
+from ..parallel import exchange
+from ..parallel.mesh import AXIS, make_mesh
+
+SENTINEL = segments.SENTINEL
+
+
+def _masked_counts(valid, inverse, num_segments):
+    """Multiplicity of each distinct row produced by masked_unique."""
+    w = valid.astype(jnp.int32)
+    ids = jnp.clip(inverse, 0, num_segments - 1)
+    return jax.ops.segment_sum(w, ids, num_segments=num_segments)
+
+
+def _device_step(triples, n_valid, min_support, *, projections,
+                 cap_exchange_a, cap_exchange_b, cap_pairs, cap_exchange_c):
+    """One device's slice of the discovery step (runs inside shard_map)."""
+    num_dev = jax.lax.psum(1, AXIS)
+    t = triples.shape[0]
+    valid_t = jnp.arange(t, dtype=jnp.int32) < n_valid[0]
+
+    # --- Emission + local dedupe (combiner side of the join, cf. UnionJoinCandidates).
+    cands = emit_join_candidates(triples, frequency.no_filter(valid_t), projections)
+    cols, valid, _, _ = segments.masked_unique(
+        [cands.join_val, cands.code, cands.v1, cands.v2], cands.valid)
+
+    # --- Exchange A: co-locate equal join values.
+    bucket = hashing.bucket_of([cols[0]], num_dev, seed=1)
+    cols, valid, ovf_a = exchange.bucket_exchange(cols, valid, bucket, AXIS,
+                                                  cap_exchange_a)
+
+    # --- Join lines: distinct (value, capture), sorted by value at the owner.
+    cols, valid, _, n_rows = segments.masked_unique(cols, valid)
+    jv, code, v1, v2 = cols
+
+    # --- Exchange B: capture support counting at the capture owner.
+    cap_bucket = hashing.bucket_of([code, v1, v2], num_dev, seed=2)
+    ccols, cvalid, ovf_b = exchange.bucket_exchange([code, v1, v2], valid,
+                                                     cap_bucket, AXIS, cap_exchange_b)
+    tbl_cols, tbl_valid, tbl_inv, n_caps = segments.masked_unique(ccols, cvalid)
+    tbl_counts = _masked_counts(cvalid, tbl_inv, tbl_cols[0].shape[0])
+
+    # --- Pair emission (quadratic hot path) + local partial counts.
+    pos, length, start_idx, total_pairs = pairs.line_layout(jv, n_rows)
+    ovf_p = jax.lax.psum(jnp.maximum(total_pairs - cap_pairs, 0), AXIS)
+    row, partner, pvalid = pairs.emit_pair_indices(pos, length, start_idx, cap_pairs)
+    pair_cols = [code[row], v1[row], v2[row], code[partner], v1[partner], v2[partner]]
+    pcols, pvalid2, pinv, _ = segments.masked_unique(pair_cols, pvalid)
+    pcnt = _masked_counts(pvalid, pinv, pcols[0].shape[0])
+
+    # --- Exchange C: co-locate pair partials with the dependent capture's owner.
+    pair_bucket = hashing.bucket_of(pcols[0:3], num_dev, seed=2)
+    mcols, mvalid, ovf_c = exchange.bucket_exchange(pcols + [pcnt], pvalid2,
+                                                    pair_bucket, AXIS, cap_exchange_c)
+    mkeys, mcnt_in = mcols[0:6], mcols[6]
+
+    # --- Merge partial counts across sources.
+    ucols, uvalid, uinv, _ = segments.masked_unique(mkeys, mvalid)
+    m = ucols[0].shape[0]
+    cooc = jax.ops.segment_sum(jnp.where(mvalid, mcnt_in, 0),
+                               jnp.clip(uinv, 0, m - 1), num_segments=m)
+
+    # --- Support lookup + CIND test (same-device by shared hash seed=2).
+    dep_count = exchange.sorted_join_counts(tbl_cols, tbl_counts, tbl_valid,
+                                            ucols[0:3], uvalid)
+    is_cind = uvalid & (cooc == dep_count) & (dep_count >= min_support)
+
+    d_code, d_v1, d_v2, r_code, r_v1, _ = ucols
+    implied = cc.is_subcode(r_code, d_code) & jnp.where(
+        cc.first_subcapture(d_code) == r_code, r_v1 == d_v1, r_v1 == d_v2)
+    keep = is_cind & ~implied
+
+    out_cols, n_out = segments.compact(list(ucols) + [dep_count], keep)
+    overflow = ovf_a + ovf_b + ovf_p + ovf_c
+    return (*out_cols, jnp.full(1, n_out, jnp.int32), jnp.full(1, overflow, jnp.int32))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "projections", "cap_exchange_a", "cap_exchange_b",
+                     "cap_pairs", "cap_exchange_c"))
+def _sharded_step(triples, n_valid, min_support, *, mesh, projections,
+                  cap_exchange_a, cap_exchange_b, cap_pairs, cap_exchange_c):
+    fn = functools.partial(
+        _device_step, projections=projections, cap_exchange_a=cap_exchange_a,
+        cap_exchange_b=cap_exchange_b, cap_pairs=cap_pairs,
+        cap_exchange_c=cap_exchange_c)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS), P()),
+        out_specs=P(AXIS),
+        check_vma=False,
+    )(triples, n_valid, min_support)
+
+
+def discover_sharded(triples, min_support: int, mesh=None, projections: str = "spo",
+                     clean_implied: bool = False,
+                     max_retries: int = 3) -> CindTable:
+    """Discover all CINDs with the full step sharded over `mesh` (default: all devices).
+
+    Output is identical to models.allatonce.discover.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    num_dev = mesh.devices.size
+    triples = np.asarray(triples, np.int32)
+    n = triples.shape[0]
+    if n == 0 or not any(ch in projections for ch in "spo"):
+        return CindTable.empty()
+    min_support = max(int(min_support), 1)
+
+    t_loc = segments.pow2_capacity(-(-n // num_dev))
+    padded = np.full((num_dev * t_loc, 3), np.iinfo(np.int32).max, np.int32)
+    n_valid = np.zeros(num_dev, np.int32)
+    for dev in range(num_dev):
+        lo, hi = dev * t_loc, min((dev + 1) * t_loc, n)
+        hi = max(hi, lo)
+        take = triples[lo:hi] if lo < n else triples[:0]
+        # Contiguous split: device `dev` gets rows [dev*t_loc, (dev+1)*t_loc).
+        padded[dev * t_loc: dev * t_loc + take.shape[0]] = take
+        n_valid[dev] = take.shape[0]
+
+    # Generous first-try capacities (worst case: everything lands on one device);
+    # doubled on overflow.  Real deployments plan these from data statistics.
+    n_cand = 3 * sum(ch in "spo" for ch in projections) * t_loc
+    cap_a = segments.pow2_capacity(n_cand)
+    cap_b = segments.pow2_capacity(num_dev * cap_a)
+    cap_p = segments.pow2_capacity(4 * num_dev * cap_a)
+    cap_c = cap_p
+
+    for attempt in range(max_retries):
+        out = _sharded_step(
+            jnp.asarray(padded), jnp.asarray(n_valid), jnp.int32(min_support),
+            mesh=mesh, projections=projections, cap_exchange_a=cap_a,
+            cap_exchange_b=cap_b, cap_pairs=cap_p, cap_exchange_c=cap_c)
+        *cols, n_out, overflow = out
+        if int(np.max(np.asarray(overflow))) == 0:
+            break
+        cap_a, cap_b, cap_p, cap_c = (2 * cap_a, 2 * cap_b, 2 * cap_p, 2 * cap_c)
+    else:
+        raise RuntimeError(
+            f"bucket-exchange overflow persisted after {max_retries} retries")
+
+    # Collect per-device outputs: cols are (num_dev * block,) arrays.
+    cols = [np.asarray(c) for c in cols]
+    n_out = np.asarray(n_out)
+    block = cols[0].shape[0] // num_dev
+    keep = np.zeros(cols[0].shape[0], bool)
+    for dev in range(num_dev):
+        keep[dev * block: dev * block + int(n_out[dev])] = True
+    d_code, d_v1, d_v2, r_code, r_v1, r_v2, support = (c[keep] for c in cols)
+
+    table = CindTable(
+        dep_code=d_code.astype(np.int64), dep_v1=d_v1.astype(np.int64),
+        dep_v2=d_v2.astype(np.int64), ref_code=r_code.astype(np.int64),
+        ref_v1=r_v1.astype(np.int64), ref_v2=r_v2.astype(np.int64),
+        support=support.astype(np.int64))
+    if clean_implied:
+        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+    return table

@@ -1,0 +1,33 @@
+"""Integer mixing hashes for bucket routing.
+
+Plays the role of the reference's MurmurHash (rdfind-util/.../ie/ucd/murmur/
+MurmurHash.java:30-207, used for partitioning and Bloom filters): deterministic,
+well-mixed 32-bit hashes computed elementwise on device.  Uses the splitmix32
+finalizer (public-domain construction) on uint32 lanes — multiply/xor/shift only,
+ideal for TPU vector units.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def mix32(x):
+    """splitmix32 finalizer over int32/uint32 arrays; returns uint32."""
+    x = x.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+def hash_cols(cols, seed: int = 0):
+    """Combine several int32 columns into one well-mixed uint32 hash."""
+    h = jnp.uint32(0x9E3779B9 * (seed + 1) & 0xFFFFFFFF)
+    for c in cols:
+        h = mix32(c.astype(jnp.uint32) ^ (h + jnp.uint32(0x9E3779B9)))
+    return h
+
+
+def bucket_of(cols, num_buckets, seed: int = 0):
+    """Deterministic bucket id in [0, num_buckets) from int32 key columns."""
+    return (hash_cols(cols, seed) % jnp.uint32(num_buckets)).astype(jnp.int32)

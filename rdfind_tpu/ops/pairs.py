@@ -27,6 +27,21 @@ import jax.ops
 
 from . import segments
 
+# Saturation bound for pair-count prefix sums: large enough that any real capacity
+# is below it, small enough that a single add can never wrap int32.
+SAT = jnp.int32(1 << 30)
+
+
+def saturating_cumsum(x):
+    """Inclusive prefix sum of non-negative int32 with saturation at SAT.
+
+    min(a+b, SAT) is associative for non-negative operands, so this lowers to an
+    O(log n) associative scan; unlike a plain cumsum it cannot wrap int32, which
+    keeps overflow *detection* exact however quadratic the pair counts get.
+    """
+    x = jnp.minimum(x, SAT)
+    return jax.lax.associative_scan(lambda a, b: jnp.minimum(a + b, SAT), x)
+
 
 def line_layout(line_val, n_valid):
     """Run layout over candidate rows sorted by join value, valid-prefix masked.
@@ -48,27 +63,35 @@ def line_layout(line_val, n_valid):
     length = jnp.where(valid, counts[gid], 1)
     run_start = jax.lax.cummax(jnp.where(starts, idx, 0))
     pos = idx - run_start
-    total_pairs = (length - 1).sum()
+    # Saturating sum: exact below SAT, pinned at SAT beyond -- callers compare it
+    # against capacities far below SAT, so overflow handling stays correct.
+    cum = saturating_cumsum(length - 1)
+    total_pairs = cum[-1] if n else jnp.int32(0)
     return pos, length, run_start, total_pairs
 
 
-def emit_pairs(line_cap, pos, length, start_idx, capacity: int):
-    """All ordered (dep, ref) co-occurrence pairs, padded to a static capacity.
+def emit_pair_indices(pos, length, start_idx, capacity: int):
+    """Row/partner indices of all ordered co-occurrence pairs, statically padded.
 
-    Returns (dep, ref, pair_valid).  Rows beyond the true total carry SENTINEL keys.
-    `capacity` must be >= total_pairs (callers size it from line_layout's total).
+    Returns (row, partner, pair_valid): gather payload columns at `row` (dependent)
+    and `partner` (referenced) to materialize pairs.  Rows beyond the true total are
+    garbage (masked by pair_valid).  If total pairs exceed `capacity`, the excess is
+    truncated — callers must compare line_layout's total against capacity and
+    retry/chunk on overflow.
     """
-    n = line_cap.shape[0]
+    n = pos.shape[0]
     reps = length - 1
-    total = reps.sum()
-    row = jnp.repeat(jnp.arange(n, dtype=jnp.int32), reps, total_repeat_length=capacity)
-    block_start = jnp.repeat(jnp.cumsum(reps).astype(jnp.int32) - reps, reps,
-                             total_repeat_length=capacity)
+    # Saturating prefix sum instead of jnp.repeat's internal cumsum: immune to int32
+    # wrap on quadratic totals (see saturating_cumsum).
+    cum = saturating_cumsum(reps)
+    total = cum[-1]
     out_idx = jnp.arange(capacity, dtype=jnp.int32)
     pair_valid = out_idx < total
+    # Row owning output slot k: first row whose inclusive cumsum exceeds k.
+    row = jnp.searchsorted(cum, out_idx, side="right").astype(jnp.int32)
+    row = jnp.clip(row, 0, n - 1)
+    block_start = cum[row] - reps[row]
     j = out_idx - block_start + 1
     partner = start_idx[row] + (pos[row] + j) % length[row]
     partner = jnp.clip(partner, 0, n - 1)  # tail rows repeat the last real row; masked
-    dep = jnp.where(pair_valid, line_cap[row], segments.SENTINEL)
-    ref = jnp.where(pair_valid, line_cap[partner], segments.SENTINEL)
-    return dep, ref, pair_valid
+    return row, partner, pair_valid

@@ -40,6 +40,12 @@ def run_starts(sorted_cols):
 SENTINEL = jnp.iinfo(jnp.int32).max
 
 
+def pow2_capacity(n: int) -> int:
+    """Smallest power of two >= n (>= 1): the capacity-bucketing policy that keeps
+    compiled stage programs reusable across datasets."""
+    return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
+
+
 def masked_row_counts(cols, valid):
     """For each row, how many valid rows share its key.  Fixed-shape, jittable.
 

@@ -1,0 +1,8 @@
+"""Collective dataflow layer: mesh construction + bucket exchanges over ICI/DCN.
+
+The TPU-native replacement for the reference's Flink shuffle runtime (hash shuffles
+between operators, broadcast variables, combiner trees — SURVEY.md §2h): a shuffle is
+a fixed-capacity bucket exchange built on jax.lax.all_to_all inside shard_map, a
+broadcast is replication/psum, and the driver↔worker control plane is the host
+program orchestrating jitted collective steps.
+"""

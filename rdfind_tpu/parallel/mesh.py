@@ -1,0 +1,30 @@
+"""Device mesh helpers.
+
+A 1-D data mesh is the core topology for CIND discovery (the workload is batch
+dataflow, not tensor algebra): every exchange is value- or capture-hash bucketed
+all_to_all over the single axis, which XLA lowers to ICI collectives within a slice
+and DCN across slices.  Mirrors the role of StratosphereParameters'
+degree-of-parallelism + executor config (rdfind-util/.../StratosphereParameters.
+java:35-154).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+AXIS = "d"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over the first `n_devices` available devices (all by default)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available")
+        devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.asarray(devices), (AXIS,))

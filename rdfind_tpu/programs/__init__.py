@@ -1,0 +1,3 @@
+"""CLI driver programs — the L6 layer (rdfind-algorithm/.../programs/): RDFind plus
+the statistics oracles CountTriples, CountConditions, CountDistinctValues,
+CheckHashCollisions."""

@@ -1,0 +1,112 @@
+"""The RDFind CLI: discover CINDs in RDF datasets on TPU.
+
+Flag surface mirrors the reference's Parameters (programs/RDFind.scala:639-721).
+Flags whose machinery is built-in or obsolete here are accepted for compatibility
+and noted in help:
+  --find-frequent-captures  exact capture-support pruning is always on;
+  --hash-dictionary/--apply-hash/--hash-*  subsumed by exact string interning;
+  --no-bulk-merge/--no-combinable-join  merge is always combiner-style;
+  --sbf-bytes/--explicit-threshold/--balanced-overlap-candidates  approximate-
+      strategy tuning, honored once strategies 2/3 land natively.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rdfind-tpu",
+        description="Discover Conditional Inclusion Dependencies in RDF datasets "
+                    "(TPU-native rebuild of stratosphere/rdfind).")
+    p.add_argument("inputs", nargs="+", help="input .nt/.nq[.gz] files or globs")
+    p.add_argument("--prefixes", nargs="*", default=[],
+                   help="nt-prefix files for URL shortening")
+    p.add_argument("--support", type=int, default=10,
+                   help="minimum support for CINDs (default 10)")
+    p.add_argument("--traversal-strategy", type=int, default=1,
+                   help="0=all-at-once 1=small-to-large 2=approx 3=late-bb")
+    p.add_argument("--projection", default="spo",
+                   help="fields to project captures on (subset of 'spo')")
+    p.add_argument("--use-fis", action="store_true",
+                   help="mine + use frequent item sets for pruning")
+    p.add_argument("--use-ars", action="store_true",
+                   help="mine + use association rules")
+    p.add_argument("--clean-implied", action="store_true",
+                   help="remove implied CINDs (minimality cleanup)")
+    p.add_argument("--distinct-triples", action="store_true")
+    p.add_argument("--asciify-triples", action="store_true")
+    p.add_argument("--tabs", action="store_true", help="tab-separated input")
+    p.add_argument("--only-read", action="store_true")
+    p.add_argument("--do-only-join", action="store_true", dest="only_join")
+    p.add_argument("--output", default=None, help="CIND output file")
+    p.add_argument("--ar-output", default=None, help="association-rule output file")
+    p.add_argument("--collect-result", action="store_true",
+                   help="print CINDs to stdout")
+    p.add_argument("--debug-level", type=int, default=0)
+    p.add_argument("--counters", type=int, default=0, dest="counter_level")
+    p.add_argument("--dop", type=int, default=1,
+                   help="degree of parallelism = number of devices in the mesh")
+    # Accepted-for-compatibility (behavior built-in or pending):
+    for flag in ("--find-frequent-captures", "--no-bulk-merge",
+                 "--no-combinable-join", "--rebalance-join", "--apply-hash",
+                 "--hash-dictionary", "--balanced-overlap-candidates",
+                 "--only-read-compat"):
+        p.add_argument(flag, action="store_true", help=argparse.SUPPRESS)
+    for flag, dv in (("--rebalance-strategy", 1), ("--rebalance-split", 1),
+                     ("--rebalance-max-load", 10000 * 10000),
+                     ("--merge-window-size", -1), ("--sbf-bytes", -1),
+                     ("--explicit-threshold", -1), ("--hash-bytes", -1),
+                     ("--frequent-condition-strategy", 0),
+                     ("--find-only-fcs", 0)):
+        p.add_argument(flag, type=int, default=dv, help=argparse.SUPPRESS)
+    p.add_argument("--rebalance-threshold", type=float, default=1.0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--hash-function", default="MD5", help=argparse.SUPPRESS)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.dop > 1 and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # Allow --dop on CPU-only hosts (the minicluster analog): request fake
+        # host devices before the JAX backend initializes.  No effect if a real
+        # multi-chip platform provides enough devices.
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   f" --xla_force_host_platform_device_count={args.dop}"
+                                   ).strip()
+    from ..runtime import driver  # deferred: must follow XLA_FLAGS setup
+
+    cfg = driver.Config(
+        input_paths=args.inputs,
+        prefix_paths=args.prefixes,
+        min_support=args.support,
+        traversal_strategy=args.traversal_strategy,
+        projections=args.projection,
+        use_frequent_item_set=args.use_fis,
+        use_association_rules=args.use_ars,
+        clean_implied=args.clean_implied,
+        distinct_triples=args.distinct_triples,
+        asciify_triples=args.asciify_triples,
+        tabs=args.tabs,
+        only_read=args.only_read,
+        only_join=args.only_join,
+        output_file=args.output,
+        ar_output_file=args.ar_output,
+        collect_result=args.collect_result,
+        debug_level=args.debug_level,
+        counter_level=args.counter_level,
+        n_devices=args.dop,
+    )
+    result = driver.run(cfg)
+    if not (cfg.output_file or cfg.collect_result):
+        print(f"Detected {len(result.table)} CINDs.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+"""End-to-end drivers and the CLI parameter surface — the analog of
+rdfind-flink's AbstractProgram/AbstractFlinkProgram lifecycle
+(jobs/AbstractProgram.java:50-139, AbstractFlinkProgram.java:23-247)."""

@@ -1,0 +1,211 @@
+"""The RDFind job driver: read -> parse -> preprocess -> discover -> sink.
+
+Mirrors the reference's program lifecycle (AbstractProgram.java:112-139: prepare,
+execute, statistics, cleanup) and its plan construction (RDFind.createFlinkPlan,
+programs/RDFind.scala:196-580), with Flink stages replaced by host ingest + the
+jitted device pipelines.  Per-phase wall-clock is recorded like JobMeasurement
+(AbstractFlinkProgram.java:65-77,203-247), including the machine-readable CSV line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+import numpy as np
+
+from .. import oracle
+from ..data import CindTable
+from ..dictionary import Dictionary, intern_triples
+from ..io import ntriples, prefixes, reader
+from ..models import allatonce, sharded
+from ..parallel.mesh import make_mesh
+
+
+@dataclasses.dataclass
+class Config:
+    """Mirrors the reference's Parameters (programs/RDFind.scala:639-721); flags
+    that are meaningless off-JVM (e.g. -jar) are dropped, flags whose machinery is
+    built-in here (e.g. --find-frequent-captures: always on, exact) are accepted and
+    noted in the CLI help."""
+
+    input_paths: list[str] = dataclasses.field(default_factory=list)
+    prefix_paths: list[str] = dataclasses.field(default_factory=list)
+    min_support: int = 10
+    traversal_strategy: int = 1
+    projections: str = "spo"
+    use_frequent_item_set: bool = False
+    use_association_rules: bool = False
+    clean_implied: bool = False
+    distinct_triples: bool = False
+    asciify_triples: bool = False
+    tabs: bool = False
+    only_read: bool = False
+    only_join: bool = False
+    output_file: str | None = None
+    ar_output_file: str | None = None
+    collect_result: bool = False
+    debug_level: int = 0
+    counter_level: int = 0
+    n_devices: int = 1  # degree of parallelism (the reference's -dop)
+
+
+@dataclasses.dataclass
+class RunResult:
+    table: CindTable
+    dictionary: Dictionary | None
+    triples: np.ndarray | None
+    counters: dict
+    timings: dict  # phase -> seconds
+
+    def decoded(self):
+        return self.table.decoded(self.dictionary)
+
+
+class _Phases:
+    def __init__(self):
+        self.timings = {}
+
+    def run(self, name, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        self.timings[name] = time.perf_counter() - t0
+        return out
+
+
+def load_triples(cfg: Config, phases: _Phases, counters: dict):
+    """Host ingest: files -> list of (s, p, o) string tokens."""
+    paths = reader.resolve_path_patterns(cfg.input_paths)
+    is_nq = paths[0].endswith((".nq", ".nq.gz"))
+
+    def parse_all():
+        out = []
+        for _, line in reader.iter_lines(paths):
+            t = (ntriples.parse_tab_line(line) if cfg.tabs
+                 else ntriples.parse_line(line, expect_quad=is_nq))
+            if t is not None:
+                out.append(t)
+        return out
+
+    triples = phases.run("read+parse", parse_all)
+    counters["input-triples"] = len(triples)
+
+    if cfg.asciify_triples:
+        triples = phases.run("asciify", lambda: [
+            tuple(prefixes.asciify(v) for v in t) for t in triples])
+
+    if cfg.prefix_paths:
+        def shorten():
+            ppaths = reader.resolve_path_patterns(cfg.prefix_paths)
+            pairs = []
+            for _, line in reader.iter_lines(ppaths):
+                p = prefixes.parse_prefix_line(line)
+                if p is not None:
+                    pairs.append(p)
+            trie = prefixes.build_prefix_trie(pairs)
+            url_of = dict(pairs)
+            return [tuple(prefixes.shorten_term(v, trie, url_of) for v in t)
+                    for t in triples]
+
+        triples = phases.run("shorten-urls", shorten)
+
+    return triples
+
+
+def run(cfg: Config) -> RunResult:
+    phases = _Phases()
+    counters: dict = {}
+
+    raw = load_triples(cfg, phases, counters)
+    if cfg.only_read:
+        _report(cfg, counters, phases.timings)
+        return RunResult(CindTable.empty(), None, None, counters, phases.timings)
+
+    ids, dictionary = phases.run(
+        "intern", lambda: intern_triples(np.asarray(raw, dtype=object)))
+    counters["distinct-values"] = len(dictionary)
+    del raw
+
+    if cfg.distinct_triples:
+        ids = phases.run("distinct", lambda: np.unique(ids, axis=0))
+        counters["distinct-triples"] = ids.shape[0]
+
+    if cfg.only_join:
+        _report(cfg, counters, phases.timings)
+        return RunResult(CindTable.empty(), dictionary, ids, counters, phases.timings)
+
+    if cfg.use_association_rules or cfg.ar_output_file:
+        print("note: association-rule mining not yet implemented natively; "
+              "--use-ars/--ar-output are ignored (CIND output unaffected: AR use "
+              "only removes AR-implied CINDs)", file=sys.stderr)
+
+    stats: dict = {}
+
+    def discover():
+        if cfg.n_devices > 1:
+            mesh = make_mesh(cfg.n_devices)
+            return sharded.discover_sharded(
+                ids, cfg.min_support, mesh=mesh, projections=cfg.projections,
+                clean_implied=cfg.clean_implied)
+        # Strategy dispatch (TraversalStrategy registry, RDFind.scala:50-56).
+        strategy = STRATEGIES.get(cfg.traversal_strategy)
+        if strategy is None:
+            raise ValueError(f"unknown traversal strategy {cfg.traversal_strategy}")
+        return strategy(
+            ids, cfg.min_support, projections=cfg.projections,
+            use_frequent_condition_filter=cfg.use_frequent_item_set,
+            clean_implied=cfg.clean_implied, stats=stats)
+
+    table = phases.run("discover", discover)
+    counters["cind-counter"] = len(table)
+    counters.update({f"stat-{k}": v for k, v in stats.items()})
+
+    if cfg.output_file:
+        def write():
+            cinds = table.decoded(dictionary)
+            with open(cfg.output_file, "w") as f:
+                for c in sorted(cinds, key=lambda c: c.pretty()):
+                    f.write(c.pretty() + "\n")
+        phases.run("write-output", write)
+
+    if cfg.collect_result or cfg.debug_level >= 3:
+        for c in table.decoded(dictionary):
+            print(c.pretty())
+
+    _report(cfg, counters, phases.timings)
+    return RunResult(table, dictionary, ids, counters, phases.timings)
+
+
+def _report(cfg: Config, counters: dict, timings: dict) -> None:
+    """Post-run statistics, incl. the CSV line (AbstractFlinkProgram.java:149-182)."""
+    if cfg.counter_level >= 1:
+        for k, v in sorted(counters.items()):
+            print(f"{k}: {v}", file=sys.stderr)
+    if cfg.debug_level >= 1 or cfg.counter_level >= 1:
+        total = sum(timings.values())
+        for name, secs in timings.items():
+            print(f"phase {name}: {secs * 1000:.1f} ms", file=sys.stderr)
+        print(f"total: {total * 1000:.1f} ms", file=sys.stderr)
+        csv = ",".join([f"{timings.get(k, 0.0) * 1000:.0f}"
+                        for k in ("read+parse", "intern", "discover")]
+                       + [f"{total * 1000:.0f}", str(counters.get("cind-counter", 0))])
+        print(f"csv:{csv}", file=sys.stderr)
+
+
+def _not_implemented_strategy(name, fallback):
+    def f(*args, **kwargs):
+        print(f"note: traversal strategy {name} not yet implemented natively; "
+              f"using all-at-once (identical output)", file=sys.stderr)
+        return fallback(*args, **kwargs)
+    return f
+
+
+# Strategy ids follow the reference (RDFind.scala:50-56): 0 = all-at-once,
+# 1 = small-to-large (default), 2 = approximate all-at-once, 3 = late-BB.
+STRATEGIES = {
+    0: allatonce.discover,
+    1: _not_implemented_strategy("small-to-large", allatonce.discover),
+    2: _not_implemented_strategy("approximate-all-at-once", allatonce.discover),
+    3: _not_implemented_strategy("late-bb", allatonce.discover),
+}

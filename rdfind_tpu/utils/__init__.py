@@ -1,0 +1,1 @@
+"""Host-side helpers: synthetic data generation, sorted-set algebra, tries."""

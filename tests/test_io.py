@@ -1,0 +1,93 @@
+"""Ingest-layer tests: parsing, reading, prefixes, trie."""
+
+import gzip
+
+import pytest
+
+from rdfind_tpu.io import ntriples, prefixes, reader
+from rdfind_tpu.utils.trie import StringTrie
+
+
+def test_parse_iri_triple():
+    s, p, o = ntriples.parse_line("<http://a> <http://b> <http://c> .")
+    assert (s, p, o) == ("<http://a>", "<http://b>", "<http://c>")
+
+
+def test_parse_literals():
+    line = '<http://a> <http://b> "hello world" .'
+    assert ntriples.parse_line(line)[2] == '"hello world"'
+    line = '<http://a> <http://b> "hi"@en .'
+    assert ntriples.parse_line(line)[2] == '"hi"@en'
+    line = '<http://a> <http://b> "5"^^<http://int> .'
+    assert ntriples.parse_line(line)[2] == '"5"^^<http://int>'
+    line = r'<http://a> <http://b> "esc\"aped" .'
+    assert ntriples.parse_line(line)[2] == r'"esc\"aped"'
+
+
+def test_parse_blank_nodes_and_quads():
+    s, p, o = ntriples.parse_line("_:b1 <http://p> _:b2 .")
+    assert (s, p, o) == ("_:b1", "<http://p>", "_:b2")
+    s, p, o = ntriples.parse_line(
+        "<http://s> <http://p> <http://o> <http://graph> .", expect_quad=True)
+    assert (s, p, o) == ("<http://s>", "<http://p>", "<http://o>")
+
+
+def test_parse_blank_and_errors():
+    assert ntriples.parse_line("   ") is None
+    with pytest.raises(ntriples.ParseError):
+        ntriples.parse_line("<http://a> <http://b> .")
+    with pytest.raises(ntriples.ParseError):
+        ntriples.parse_line('<http://a> <http://b> "unterminated .')
+
+
+def test_parse_tabs():
+    assert ntriples.parse_tab_line("a\tb\tc") == ("a", "b", "c")
+    assert ntriples.parse_tab_line("  ") is None
+
+
+def test_reader_gz_and_comments(tmp_path):
+    plain = tmp_path / "a.nt"
+    plain.write_text("# comment\n<s1> <p> <o> .\n")
+    gz = tmp_path / "b.nt.gz"
+    with gzip.open(gz, "wt") as f:
+        f.write("<s2> <p> <o> .\n# another\n")
+    paths = reader.resolve_path_patterns([str(tmp_path / "*.nt*")])
+    lines = list(reader.iter_lines(paths))
+    assert [(fid, ln.split()[0]) for fid, ln in lines] == [(0, "<s1>"), (1, "<s2>")]
+
+
+def test_reader_missing_file():
+    with pytest.raises(FileNotFoundError):
+        reader.resolve_path_patterns(["/nonexistent/xyz*.nt"])
+
+
+def test_trie_longest_prefix():
+    t = StringTrie()
+    t["http://dbpedia.org/resource/"] = "dbr:"
+    t["http://dbpedia.org/"] = "dbp:"
+    t["http://example.org/"] = "ex:"
+    for squash in (False, True):
+        if squash:
+            t.squash()
+        assert t.longest_prefix_value("http://dbpedia.org/resource/Berlin") == "dbr:"
+        assert t.longest_prefix_value("http://dbpedia.org/ontology/x") == "dbp:"
+        assert t.longest_prefix_value("http://example.org/a") == "ex:"
+        assert t.longest_prefix_value("http://other.org/") is None
+
+
+def test_prefix_parse_and_shorten():
+    pair = prefixes.parse_prefix_line("@prefix dbr: <http://dbpedia.org/resource/> .")
+    assert pair == ("dbr:", "http://dbpedia.org/resource/")
+    assert prefixes.parse_prefix_line("# not a prefix") is None
+    trie = prefixes.build_prefix_trie([pair])
+    urls = dict([pair])
+    assert prefixes.shorten_term("<http://dbpedia.org/resource/Berlin>", trie, urls) \
+        == "dbr:Berlin"
+    assert prefixes.shorten_term('"literal"', trie, urls) == '"literal"'
+    assert prefixes.shorten_term("<http://other/x>", trie, urls) == "<http://other/x>"
+
+
+def test_asciify():
+    assert prefixes.asciify("plain") == "plain"
+    assert prefixes.asciify("Zürich") == "Zurich"
+    assert prefixes.asciify("日本") == "??"

@@ -1,0 +1,103 @@
+"""End-to-end CLI tests on a small N-Triples fixture."""
+
+import gzip
+
+import pytest
+
+from rdfind_tpu import oracle
+from rdfind_tpu.programs import (check_hash_collisions, count_conditions,
+                                 count_distinct_values, count_triples, rdfind)
+
+FIXTURE = """\
+# people fixture
+<alice> <bornIn> <berlin> .
+<bob> <bornIn> <berlin> .
+<carol> <bornIn> <paris> .
+<alice> <livesIn> <berlin> .
+<bob> <livesIn> <berlin> .
+<carol> <livesIn> <paris> .
+<dave> <livesIn> <rome> .
+"""
+
+
+@pytest.fixture()
+def fixture_file(tmp_path):
+    f = tmp_path / "people.nt"
+    f.write_text(FIXTURE)
+    return str(f)
+
+
+def test_rdfind_cli_end_to_end(fixture_file, tmp_path, capsys):
+    out = tmp_path / "cinds.txt"
+    rc = rdfind.main([fixture_file, "--support", "2", "--clean-implied",
+                      "--output", str(out), "--counters", "1"])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    assert "s[p=<bornIn>] < s[p=<livesIn>] (support=3)" in lines
+    # Golden parity with the oracle on the same file.
+    triples = [tuple(t) for t in [
+        ("<alice>", "<bornIn>", "<berlin>"), ("<bob>", "<bornIn>", "<berlin>"),
+        ("<carol>", "<bornIn>", "<paris>"), ("<alice>", "<livesIn>", "<berlin>"),
+        ("<bob>", "<livesIn>", "<berlin>"), ("<carol>", "<livesIn>", "<paris>"),
+        ("<dave>", "<livesIn>", "<rome>")]]
+    want = oracle.minimize_cinds(oracle.discover_cinds_definitional(triples, 2))
+    assert len(lines) == len(want)
+    err = capsys.readouterr().err
+    assert "cind-counter" in err and "csv:" in err
+
+
+def test_rdfind_cli_count_only(fixture_file, capsys):
+    rc = rdfind.main([fixture_file, "--support", "2"])
+    assert rc == 0
+    assert "Detected" in capsys.readouterr().out
+
+
+def test_rdfind_cli_gz_and_strategy(fixture_file, tmp_path, capsys):
+    gz = tmp_path / "people.nt.gz"
+    with gzip.open(gz, "wt") as f:
+        f.write(FIXTURE)
+    rc = rdfind.main([str(gz), "--support", "2", "--traversal-strategy", "0",
+                      "--use-fis"])
+    assert rc == 0
+    out_a = capsys.readouterr().out
+    rc = rdfind.main([fixture_file, "--support", "2"])
+    assert rc == 0
+    assert capsys.readouterr().out == out_a  # same counts, gz + strategy invariant
+
+
+def test_rdfind_only_read(fixture_file, capsys):
+    rc = rdfind.main([fixture_file, "--only-read", "--counters", "1"])
+    assert rc == 0
+    assert "input-triples: 7" in capsys.readouterr().err
+
+
+def test_count_triples(fixture_file, capsys):
+    count_triples.main([fixture_file])
+    assert "Counted 7 triples." in capsys.readouterr().out
+
+
+def test_count_distinct_values(fixture_file, capsys):
+    count_distinct_values.main([fixture_file])
+    out = capsys.readouterr().out
+    assert "Distinct URLs: 9" in out  # 4 people + 2 predicates + 3 places
+    assert "Distinct literals: 0" in out
+
+
+def test_count_conditions(fixture_file, capsys):
+    count_conditions.main([fixture_file])
+    out = capsys.readouterr().out
+    assert "capture code" in out and "unary" in out and "binary" in out
+
+
+def test_check_hash_collisions(fixture_file, capsys):
+    check_hash_collisions.main([fixture_file])
+    out = capsys.readouterr().out
+    assert "Colliding values: 0" in out
+
+
+def test_rdfind_empty_input(tmp_path, capsys):
+    f = tmp_path / "empty.nt"
+    f.write_text("# only a comment\n")
+    rc = rdfind.main([str(f), "--support", "2"])
+    assert rc == 0
+    assert "Detected 0 CINDs." in capsys.readouterr().out
