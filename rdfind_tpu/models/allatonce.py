@@ -55,8 +55,10 @@ def _pad_np(arr: np.ndarray, capacity: int, fill) -> np.ndarray:
     return np.concatenate([arr, np.full(capacity - arr.shape[0], fill, arr.dtype)])
 
 
-@functools.partial(jax.jit, static_argnames=("projections", "use_fc_filter"))
-def _stage_candidates(triples, n_valid, min_support, *, projections, use_fc_filter):
+@functools.partial(jax.jit,
+                   static_argnames=("projections", "use_fc_filter", "use_ars"))
+def _stage_candidates(triples, n_valid, min_support, *, projections, use_fc_filter,
+                      use_ars=False):
     """Triples -> deduped join-line rows (sorted by (value, capture)) + capture table.
 
     Returns (line_val, line_cap, n_rows, cap_code, cap_v1, cap_v2, num_caps); all
@@ -64,7 +66,8 @@ def _stage_candidates(triples, n_valid, min_support, *, projections, use_fc_filt
     """
     n = triples.shape[0]
     valid_t = jnp.arange(n, dtype=jnp.int32) < n_valid
-    freq = (frequency.triple_frequencies(triples, valid_t, min_support)
+    freq = (frequency.triple_frequencies(triples, valid_t, min_support,
+                                         find_ar_implied=use_ars)
             if use_fc_filter else frequency.no_filter(valid_t))
     cands = emit_join_candidates(triples, freq, projections)
 
@@ -186,6 +189,36 @@ def fused_step(triples, n_valid, min_support, *, projections="spo",
             s_out, n_out, overflow)
 
 
+def filter_ar_implied_cinds(table: CindTable, mined_rules) -> CindTable:
+    """Drop 1/1 CIND pairs that restate a perfect-confidence association rule.
+
+    Mirrors the evidence-level exclusion (CreateDependencyCandidates.scala:125-130
+    with its AR broadcast initializer :164-178, and FilterAssociationRuleImpliedCinds
+    .scala:30-58): the pair (dep=antecedent capture, ref=consequent capture) with the
+    shared third-field projection is suppressed.  `mined_rules` comes from
+    frequency.mine_association_rules.
+    """
+    ants, cons, avs, cvs, _ = mined_rules
+    if len(ants) == 0 or len(table) == 0:
+        return table
+    rules = set(zip(ants.tolist(), cons.tolist(), avs.tolist(), cvs.tolist()))
+    keep = np.ones(len(table), bool)
+    dep_unary = cc.is_unary(table.dep_code)
+    ref_unary = cc.is_unary(table.ref_code)
+    same_proj = cc.secondary(table.dep_code) == cc.secondary(table.ref_code)
+    cand = dep_unary & ref_unary & same_proj & \
+        (cc.primary(table.dep_code) != cc.primary(table.ref_code))
+    for i in np.flatnonzero(cand):
+        key = (int(cc.primary(int(table.dep_code[i]))),
+               int(cc.primary(int(table.ref_code[i]))),
+               int(table.dep_v1[i]), int(table.ref_v1[i]))
+        if key in rules:
+            keep[i] = False
+    return CindTable(*(np.asarray(c)[keep] for c in (
+        table.dep_code, table.dep_v1, table.dep_v2,
+        table.ref_code, table.ref_v1, table.ref_v2, table.support)))
+
+
 def _chunk_boundaries(pairs_per_line: np.ndarray, budget: int) -> list[int]:
     """Greedy packing of whole lines into chunks of <= budget pairs each.
 
@@ -205,6 +238,7 @@ def _chunk_boundaries(pairs_per_line: np.ndarray, budget: int) -> list[int]:
 
 def discover(triples, min_support: int, projections: str = "spo",
              use_frequent_condition_filter: bool = True,
+             use_association_rules: bool = False,
              clean_implied: bool = False,
              pair_chunk_budget: int = PAIR_CHUNK_BUDGET,
              stats: dict | None = None) -> CindTable:
@@ -223,10 +257,12 @@ def discover(triples, min_support: int, projections: str = "spo",
     cap_n = segments.pow2_capacity(n)
     padded = jnp.asarray(np.pad(triples, ((0, cap_n - n), (0, 0)),
                                 constant_values=np.iinfo(np.int32).max))
+    use_ars = use_association_rules and use_frequent_condition_filter
     (line_val, line_cap, n_rows, cap_code, cap_v1, cap_v2, num_caps) = \
         _stage_candidates(padded, jnp.int32(n), jnp.int32(min_support),
                           projections=projections,
-                          use_fc_filter=use_frequent_condition_filter)
+                          use_fc_filter=use_frequent_condition_filter,
+                          use_ars=use_ars)
     n_rows = int(n_rows)
     if n_rows == 0:
         return CindTable.empty()
@@ -318,6 +354,11 @@ def discover(triples, min_support: int, projections: str = "spo",
         ref_v2=cap_v2[ref_id].astype(np.int64),
         support=support.astype(np.int64),
     )
+    if use_ars:
+        rules = frequency.mine_association_rules(triples, min_support)
+        if stats is not None:
+            stats["association_rules"] = rules
+        table = filter_ar_implied_cinds(table, rules)
     if clean_implied:
         table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
     return table

@@ -62,7 +62,8 @@ def emit_join_candidates(triples, freq: TripleFrequency,
         bit_a, bit_b = _FIELD_BITS[a], _FIELD_BITS[b]
         join_val = triples[:, pi]
         ok_a, ok_b = freq.unary_ok[:, a], freq.unary_ok[:, b]
-        ok_ab = ok_a & ok_b & freq.binary_ok[:, _PAIR_INDEX[(a, b)]]
+        k = _PAIR_INDEX[(a, b)]
+        ok_ab = ok_a & ok_b & freq.binary_ok[:, k] & ~freq.binary_ar_implied[:, k]
         no_val = jnp.full(n, NO_VALUE, jnp.int32)
         parts.append((join_val, cc.create(bit_a, secondary_condition=proj_bit),
                       triples[:, a], no_val, ok_a))

@@ -1,15 +1,19 @@
-"""Frequent-condition mining as segment counting.
+"""Frequent-condition mining + association rules as segment counting.
 
 Replaces the reference's FrequentConditionPlanner count pipelines
-(plan/FrequentConditionPlanner.scala:291-311 for unary, :374-394 for binary): a
-condition (field=value, or field-pair=value-pair) is *frequent* when at least
-``min_support`` triples satisfy it.  Frequency here is a conservative prefilter — a
-capture can never be larger than its condition's triple count — so pruning on it
-never changes the final CIND set (the exact support test happens downstream).
+(plan/FrequentConditionPlanner.scala:291-311 unary, :374-394 binary, :130-194
+association rules): a condition (field=value, or field-pair=value-pair) is
+*frequent* when at least ``min_support`` triples satisfy it.  Frequency is a
+conservative prefilter — a capture can never be larger than its condition's triple
+count — so pruning on it never changes the final CIND set (the exact support test
+happens downstream).
 
 Instead of Bloom filters broadcast to workers, counts are computed exactly via
 group-by-and-count and mapped straight back onto the triple rows that asked — the
 query set and the count set are the same rows, so membership testing disappears.
+The same trick makes association rules *local*: the perfect-confidence test for the
+rule (a=va) -> (b=vb) is count(a=va ∧ b=vb) == count(a=va), evaluable per triple row
+from the group counts — no rule broadcast needed at emission time.
 
 Fixed-shape and jittable: `valid` masks padding rows, which always count as 0.
 """
@@ -18,43 +22,102 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
+from .. import conditions as cc
 from . import segments
 
 _FIELD_PAIRS = ((0, 1), (0, 2), (1, 2))  # (s,p), (s,o), (p,o) in ascending bit order
+_FIELD_BITS = (cc.SUBJECT, cc.PREDICATE, cc.OBJECT)
 
 
 @dataclasses.dataclass
 class TripleFrequency:
     """Per-triple-row frequency verdicts.
 
-    unary_ok[i, f]   -- field f's value in row i occurs >= min_support times in f;
-    binary_ok[i, k]  -- row i's value pair for field-pair k occurs >= min_support
-                        times (k indexes _FIELD_PAIRS).
+    unary_ok[i, f]       -- field f's value in row i occurs >= min_support times;
+    binary_ok[i, k]      -- row i's value pair for field-pair k occurs >= min_support
+                            times (k indexes _FIELD_PAIRS);
+    binary_ar_implied[i, k] -- the pair condition is implied by a perfect-confidence
+                            association rule (either direction), i.e. the binary
+                            capture equals one of its unary halves extensionally.
     """
 
     unary_ok: jnp.ndarray  # (N, 3) bool
     binary_ok: jnp.ndarray  # (N, 3) bool
+    binary_ar_implied: jnp.ndarray  # (N, 3) bool
 
 
-def triple_frequencies(triples, valid, min_support) -> TripleFrequency:
+def triple_frequencies(triples, valid, min_support,
+                       find_ar_implied: bool = False) -> TripleFrequency:
     """Exact unary + binary condition frequencies, evaluated on the triples' own rows."""
-    unary_ok = [
-        segments.masked_row_counts([triples[:, f]], valid) >= min_support
-        for f in range(3)
-    ]
-    binary_ok = [
-        segments.masked_row_counts([triples[:, a], triples[:, b]], valid) >= min_support
-        for a, b in _FIELD_PAIRS
-    ]
-    return TripleFrequency(
-        unary_ok=jnp.stack(unary_ok, axis=1),
-        binary_ok=jnp.stack(binary_ok, axis=1),
-    )
+    unary_cnt = [segments.masked_row_counts([triples[:, f]], valid) for f in range(3)]
+    binary_cnt = [segments.masked_row_counts([triples[:, a], triples[:, b]], valid)
+                  for a, b in _FIELD_PAIRS]
+    unary_ok = jnp.stack([c >= min_support for c in unary_cnt], axis=1)
+    binary_ok = jnp.stack([c >= min_support for c in binary_cnt], axis=1)
+    if find_ar_implied:
+        # Rule (a -> b) or (b -> a) with confidence 1 over frequent conditions:
+        # emission then suppresses the redundant binary capture
+        # (CreateJoinPartners.scala:100-146 with the AR broadcast).
+        ar = jnp.stack([
+            (binary_cnt[k] == unary_cnt[a]) | (binary_cnt[k] == unary_cnt[b])
+            for k, (a, b) in enumerate(_FIELD_PAIRS)
+        ], axis=1) & binary_ok
+    else:
+        ar = jnp.zeros_like(binary_ok)
+    return TripleFrequency(unary_ok=unary_ok, binary_ok=binary_ok,
+                           binary_ar_implied=ar)
 
 
 def no_filter(valid) -> TripleFrequency:
     """All-pass verdicts for valid rows (the --no-frequent-item-set path)."""
     ok = jnp.tile(valid[:, None], (1, 3))
-    return TripleFrequency(ok, ok)
+    return TripleFrequency(ok, ok, jnp.zeros_like(ok))
+
+
+@jax.jit
+def _stage_rules(triples, n_valid, min_support):
+    """All perfect-confidence association rules, compacted to the front.
+
+    Returns (ant_bit, cons_bit, ant_val, cons_val, support, n_rules): one row per
+    directed rule (a=va) -> (b=vb) with count(a=va ∧ b=vb) == count(a=va) and the
+    antecedent frequent (FrequentConditionPlanner.scala:130-194; the consequent is
+    then automatically frequent).
+    """
+    n = triples.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < n_valid
+    parts = []
+    for a, b in _FIELD_PAIRS:
+        cnt_a = segments.masked_row_counts([triples[:, a]], valid)
+        cnt_b = segments.masked_row_counts([triples[:, b]], valid)
+        cnt_ab = segments.masked_row_counts([triples[:, a], triples[:, b]], valid)
+        for ant, con, cnt_u in ((a, b, cnt_a), (b, a, cnt_b)):
+            is_rule = valid & (cnt_ab == cnt_u) & (cnt_u >= min_support)
+            parts.append((jnp.full(n, _FIELD_BITS[ant], jnp.int32),
+                          jnp.full(n, _FIELD_BITS[con], jnp.int32),
+                          triples[:, ant], triples[:, con], cnt_ab, is_rule))
+    cols = [jnp.concatenate([p[i] for p in parts]) for i in range(5)]
+    mask = jnp.concatenate([p[5] for p in parts])
+    # Support (cnt_ab) is constant within a rule group, so it can ride along as a
+    # fifth key column without affecting uniqueness.
+    (full_cols, _, _, n_rules) = segments.masked_unique(cols, mask)
+    return (*full_cols, n_rules)
+
+
+def mine_association_rules(triples_np, min_support: int):
+    """Host wrapper: (N, 3) int32 -> numpy rule table (ant_bit, cons_bit, ant_val,
+    cons_val, support)."""
+    n = triples_np.shape[0]
+    if n == 0:
+        return [np.zeros(0, np.int32)] * 5
+    cap = segments.pow2_capacity(n)
+    padded = np.pad(triples_np, ((0, cap - n), (0, 0)),
+                    constant_values=np.iinfo(np.int32).max)
+    out = _stage_rules(jnp.asarray(padded), jnp.int32(n),
+                       jnp.int32(max(int(min_support), 1)))
+    *cols, n_rules = out
+    n_rules = int(n_rules)
+    return [np.asarray(c[:n_rules]) for c in cols]

@@ -94,12 +94,17 @@ def discover_cinds_definitional(triples, min_support, projections="spo"):
 
 
 def discover_cinds_joinline(triples, min_support, projections="spo",
-                            use_frequent_condition_filter=True):
+                            use_frequent_condition_filter=True,
+                            use_association_rules=False):
     """All CINDs via the reference's join-line mechanics.
 
-    Output must equal `discover_cinds_definitional` — the frequency filters are pure
-    pruning (a referenced capture of a valid CIND is at least as large as the
-    dependent, hence frequent).
+    Without association rules, output must equal `discover_cinds_definitional` — the
+    frequency filters are pure pruning (a referenced capture of a valid CIND is at
+    least as large as the dependent, hence frequent).  With use_association_rules
+    (requires the frequency filter, as in the reference where ARs are mined from the
+    frequent-item sets), AR-implied binary captures are suppressed and AR-restating
+    1/1 pairs removed (CreateJoinPartners.scala:100-146,
+    CreateDependencyCandidates.scala:125-130).
     """
     # -- Frequent-condition mining (FrequentConditionPlanner.scala:291-311,374-394).
     if use_frequent_condition_filter:
@@ -113,6 +118,18 @@ def discover_cinds_joinline(triples, min_support, projections="spo",
                 binary_counts[(_FIELD_BITS[a] | _FIELD_BITS[b], t[a], t[b])] += 1
         unary_freq = {k for k, v in unary_counts.items() if v >= min_support}
         binary_freq = {k for k, v in binary_counts.items() if v >= min_support}
+        rules = set()
+        if use_association_rules:
+            # Perfect-confidence rules over frequent conditions:
+            # (a=va) -> (b=vb) iff count(a=va ∧ b=vb) == count(a=va) >= min_support.
+            for (code, va, vb), cab in binary_counts.items():
+                if cab < min_support:
+                    continue
+                bits = [b for b in _FIELD_BITS if code & b]
+                for (ba, bb, x, y) in ((bits[0], bits[1], va, vb),
+                                       (bits[1], bits[0], vb, va)):
+                    if cab == unary_counts[(ba, x)]:
+                        rules.add((ba, bb, x, y))
 
         def u_ok(bit, val):
             return (bit, val) in unary_freq
@@ -120,6 +137,8 @@ def discover_cinds_joinline(triples, min_support, projections="spo",
         def b_ok(code, va, vb):
             return (code, va, vb) in binary_freq
     else:
+        rules = set()
+
         def u_ok(bit, val):
             return True
 
@@ -144,7 +163,10 @@ def discover_cinds_joinline(triples, min_support, projections="spo",
             if u_ok(bit_b, t[b]):
                 join_lines[join_val].add(
                     (cc.create(bit_b, secondary_condition=proj_bit), t[b], NO_VALUE))
-            if u_ok(bit_a, t[a]) and u_ok(bit_b, t[b]) and b_ok(bit_a | bit_b, t[a], t[b]):
+            ar_implied = ((bit_a, bit_b, t[a], t[b]) in rules
+                          or (bit_b, bit_a, t[b], t[a]) in rules)
+            if (u_ok(bit_a, t[a]) and u_ok(bit_b, t[b])
+                    and b_ok(bit_a | bit_b, t[a], t[b]) and not ar_implied):
                 join_lines[join_val].add((cc.create(bit_a, bit_b, proj_bit), t[a], t[b]))
 
     # -- Evidence extraction + intersection (CreateAllCindCandidates.scala:106-121,
@@ -167,6 +189,11 @@ def discover_cinds_joinline(triples, min_support, projections="spo",
             continue
         for ref in refs:
             if _implies(dep, ref):
+                continue
+            if rules and cc.is_unary(dep[0]) and cc.is_unary(ref[0]) \
+                    and cc.secondary(dep[0]) == cc.secondary(ref[0]) \
+                    and (cc.primary(dep[0]), cc.primary(ref[0]),
+                         dep[1], ref[1]) in rules:
                 continue
             out.add((*dep, *ref, support))
     return out

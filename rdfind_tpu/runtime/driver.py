@@ -135,15 +135,21 @@ def run(cfg: Config) -> RunResult:
         _report(cfg, counters, phases.timings)
         return RunResult(CindTable.empty(), dictionary, ids, counters, phases.timings)
 
-    if cfg.use_association_rules or cfg.ar_output_file:
-        print("note: association-rule mining not yet implemented natively; "
-              "--use-ars/--ar-output are ignored (CIND output unaffected: AR use "
-              "only removes AR-implied CINDs)", file=sys.stderr)
+    use_ars = cfg.use_association_rules and cfg.use_frequent_item_set
+    if cfg.use_association_rules and not cfg.use_frequent_item_set:
+        # Like the reference: ARs are mined from the frequent-item sets, so without
+        # --use-fis the AR broadcast is empty (RDFind.scala:290-296).
+        print("note: --use-ars has no effect without --use-fis "
+              "(association rules are mined from the frequent-item sets)",
+              file=sys.stderr)
 
     stats: dict = {}
 
     def discover():
         if cfg.n_devices > 1:
+            if use_ars:
+                print("note: association rules not yet wired into the multi-device "
+                      "path; running without them", file=sys.stderr)
             mesh = make_mesh(cfg.n_devices)
             return sharded.discover_sharded(
                 ids, cfg.min_support, mesh=mesh, projections=cfg.projections,
@@ -155,11 +161,35 @@ def run(cfg: Config) -> RunResult:
         return strategy(
             ids, cfg.min_support, projections=cfg.projections,
             use_frequent_condition_filter=cfg.use_frequent_item_set,
+            use_association_rules=use_ars,
             clean_implied=cfg.clean_implied, stats=stats)
 
     table = phases.run("discover", discover)
     counters["cind-counter"] = len(table)
     counters.update({f"stat-{k}": v for k, v in stats.items()})
+
+    if cfg.ar_output_file and not cfg.use_frequent_item_set:
+        # Reference parity: without --use-fis there are no frequent-item sets to
+        # mine rules from (RDFind.scala:290-296) -- write nothing.
+        print("note: --ar-output requires --use-fis; no rules written",
+              file=sys.stderr)
+    if cfg.ar_output_file and cfg.use_frequent_item_set:
+        def write_ars():
+            mined = stats.get("association_rules")
+            if mined is None:
+                from ..ops import frequency as freq_ops
+                mined = freq_ops.mine_association_rules(ids, cfg.min_support)
+            ants, cons, avs, cvs, sups = mined
+            counters["association-rules"] = len(ants)
+            from .. import conditions as cc
+            with open(cfg.ar_output_file, "w") as f:
+                for i in range(len(ants)):
+                    # AssociationRule.toString format (data/AssociationRule.scala).
+                    ant = cc.pretty(int(ants[i]), dictionary.value(int(avs[i])))
+                    con = cc.pretty(int(cons[i]), dictionary.value(int(cvs[i])))
+                    f.write(f"{ant} -> {con} (support={int(sups[i])},"
+                            f"confidence=100.00%)\n")
+        phases.run("write-ar-output", write_ars)
 
     if cfg.output_file:
         def write():

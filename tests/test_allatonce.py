@@ -111,3 +111,39 @@ def test_engine_skewed_star():
     got = run_engine(triples, 2)
     want = oracle_rows(triples, 2)
     assert canon(got) == canon(want)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_engine_association_rules_match_oracle(seed):
+    rng = random.Random(seed + 100)
+    # Small pools force perfect-confidence rules to exist.
+    triples = random_triples(rng, 60, 4, 2, 3)
+    ids, dct = intern_triples(np.asarray(triples, dtype=object))
+    id_triples = [tuple(int(x) for x in row) for row in ids]
+    got = allatonce.discover(ids, 2, use_association_rules=True).to_rows()
+    want = oracle.discover_cinds_joinline(id_triples, 2, use_association_rules=True)
+    assert got == {tuple(int(x) for x in c) for c in want}
+
+
+def test_association_rules_hand_fixture():
+    # p1 only ever occurs with object x => rule [p=p1] -> [o=x] (confidence 1).
+    triples = [("a", "p1", "x"), ("b", "p1", "x"), ("c", "p2", "x"), ("c", "p2", "y")]
+    ids, dct = intern_triples(np.asarray(triples, dtype=object))
+    from rdfind_tpu.ops import frequency
+    ants, cons, avs, cvs, sups = frequency.mine_association_rules(ids, 2)
+    rules = {(int(a), int(c), dct.value(int(av)), dct.value(int(cv)), int(s))
+             for a, c, av, cv, s in zip(ants, cons, avs, cvs, sups)}
+    from rdfind_tpu import conditions as cc2
+    assert (cc2.PREDICATE, cc2.OBJECT, "p1", "x", 2) in rules
+    # o=x is not always with p=p1 (c p2 x), so no reverse rule.
+    assert not any(r[:2] == (cc2.OBJECT, cc2.PREDICATE) and r[2] == "x" for r in rules)
+
+    # With ARs on: the 1/1 CIND s[p=p1] < s[o=x] is suppressed...
+    with_ars = allatonce.discover(ids, 2, use_association_rules=True)
+    without = allatonce.discover(ids, 2)
+    code_sp = cc2.create(cc2.PREDICATE, secondary_condition=cc2.SUBJECT)
+    code_so = cc2.create(cc2.OBJECT, secondary_condition=cc2.SUBJECT)
+    pair = (code_sp, int(dct.id("p1")), -1, code_so, int(dct.id("x")), -1, 2)
+    assert pair in without.to_rows()
+    assert pair not in with_ars.to_rows()
+    assert with_ars.to_rows() < without.to_rows()

@@ -101,3 +101,14 @@ def test_rdfind_empty_input(tmp_path, capsys):
     rc = rdfind.main([str(f), "--support", "2"])
     assert rc == 0
     assert "Detected 0 CINDs." in capsys.readouterr().out
+
+
+def test_rdfind_ar_output(tmp_path, capsys):
+    f = tmp_path / "ar.nt"
+    f.write_text("<a> <p1> <x> .\n<b> <p1> <x> .\n<c> <p2> <x> .\n<c> <p2> <y> .\n")
+    out = tmp_path / "ars.txt"
+    rc = rdfind.main([str(f), "--support", "2", "--use-fis", "--use-ars",
+                      "--ar-output", str(out)])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    assert "[p=<p1>] -> [o=<x>] (support=2,confidence=100.00%)" in lines
