@@ -198,22 +198,10 @@ def filter_ar_implied_cinds(table: CindTable, mined_rules) -> CindTable:
     shared third-field projection is suppressed.  `mined_rules` comes from
     frequency.mine_association_rules.
     """
-    ants, cons, avs, cvs, _ = mined_rules
-    if len(ants) == 0 or len(table) == 0:
+    if len(table) == 0:
         return table
-    rules = set(zip(ants.tolist(), cons.tolist(), avs.tolist(), cvs.tolist()))
-    keep = np.ones(len(table), bool)
-    dep_unary = cc.is_unary(table.dep_code)
-    ref_unary = cc.is_unary(table.ref_code)
-    same_proj = cc.secondary(table.dep_code) == cc.secondary(table.ref_code)
-    cand = dep_unary & ref_unary & same_proj & \
-        (cc.primary(table.dep_code) != cc.primary(table.ref_code))
-    for i in np.flatnonzero(cand):
-        key = (int(cc.primary(int(table.dep_code[i]))),
-               int(cc.primary(int(table.ref_code[i]))),
-               int(table.dep_v1[i]), int(table.ref_v1[i]))
-        if key in rules:
-            keep[i] = False
+    keep = ~frequency.ar_implied_pair_mask(
+        table.dep_code, table.ref_code, table.dep_v1, table.ref_v1, mined_rules)
     return CindTable(*(np.asarray(c)[keep] for c in (
         table.dep_code, table.dep_v1, table.dep_v2,
         table.ref_code, table.ref_v1, table.ref_v2, table.support)))

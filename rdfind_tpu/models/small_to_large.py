@@ -1,0 +1,517 @@
+"""SmallToLarge traversal strategy (the reference's default, id 1).
+
+Walks the CIND lattice level by level — 1/1 overlaps -> 1/1 CINDs -> 1/2 -> 2/1 ->
+2/2 — generating candidates for each level from the previous one and verifying only
+those, instead of materializing every co-occurrence pair at once (AllAtOnce).
+Mirrors plan/SmallToLargeTraversalStrategy.scala:38-171 with these mappings:
+
+  * overlap/evidence extraction + MultiunionOverlapCandidates  ->  masked, chunked
+    co-occurrence pair counting on device (ops/pairs.py rotations), restricted per
+    level to (dep-family x ref-family) captures;
+  * candidate Bloom filters between levels (:381-401 etc.)     ->  exact sorted-
+    array candidate sets, semi-joined on the host after per-chunk dedup (prunes a
+    superset of what the BF prunes; no false positives to re-verify);
+  * Generate{UnaryBinary,BinaryUnary,BinaryBinary}CindCandidates and
+    InferDoubleSingleCinds group-reduces                        ->  vectorized
+    within-group pair emission over numpy arrays (same rotation layout);
+  * the inferred-2/1 frequency join against triple-count-based frequent binary
+    conditions (:534-548, an over-approximation of capture support)  ->  exact
+    capture-support test via the always-on capture filter — output-neutral, prunes
+    strictly more.
+
+Output semantics are reference-faithful: the RAW result keeps only 2/1 CINDs whose
+unary dep subcaptures are both proper overlaps of the ref (minimal 2/1s,
+GenerateBinaryUnaryCindCandidates.scala:23-57) and 2/2 CINDs not implied by a 1/2
+CIND, so raw S2L output is a subset of raw AllAtOnce output; with clean_implied
+both strategies produce the identical minimal CIND set.  Exception, inherited from
+the reference: with use_association_rules the AR filter runs on the 1/1 CINDs
+BEFORE they seed the 1/2 / 2/1-inference / 2/2 generation
+(SmallToLargeTraversalStrategy.scala:79-86), so higher-family CINDs whose only
+generation path went through an AR-implied 1/1 CIND are missing versus AllAtOnce
+even under clean_implied.  One deliberate divergence:
+the reference's PruneNonMinimalDoubleDoubleCindCandidates.scala:42-66 only ever
+tests the FIRST 1/2 CIND of each group (a tail-recursion bound bug), making its raw
+2/2 output depend on Flink's nondeterministic group order; we implement the
+documented intent (prune against ALL 1/2 CINDs), which is deterministic and
+converges to the same clean_implied result.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import conditions as cc
+from .. import oracle
+from ..data import NO_VALUE, CindTable
+from ..ops import frequency, pairs, segments
+from . import allatonce
+
+SENTINEL = segments.SENTINEL
+
+
+# ---------------------------------------------------------------------------
+# Device stage: masked pair counting (the per-level evidence extraction).
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def _stage_pair_counts_masked(line_cap, dep_f, ref_f, pos, length, start_idx, *,
+                              capacity):
+    """One chunk of (dep-flagged x ref-flagged) co-occurrence pairs, deduped+counted.
+
+    Like allatonce._stage_pair_counts but pairs survive only when the dependent row
+    is dep-flagged and the partner row is ref-flagged — the per-level restriction
+    that replaces the reference's family-specific Create*/Extract* operators.
+    """
+    row, partner, pair_valid = pairs.emit_pair_indices(pos, length, start_idx,
+                                                       capacity)
+    pair_valid = pair_valid & dep_f[row] & ref_f[partner]
+    dep = jnp.where(pair_valid, line_cap[row], SENTINEL)
+    ref = jnp.where(pair_valid, line_cap[partner], SENTINEL)
+    perm = segments.lexsort([dep, ref])
+    ds, rs, vs = dep[perm], ref[perm], pair_valid[perm]
+    starts = segments.run_starts([ds, rs]) & vs
+    gid = jnp.cumsum(starts).astype(jnp.int32) - 1
+    cnt = jax.ops.segment_sum(vs.astype(jnp.int32), gid, num_segments=capacity)[gid]
+    (d_out, r_out, c_out), n_out = segments.compact([ds, rs, cnt], starts)
+    return d_out, r_out, c_out, n_out
+
+
+def _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats, stat_key):
+    """Global (dep, ref) -> co-occurrence counts for flagged capture pairs.
+
+    line_val_h/line_cap_h: host arrays of valid join-line rows sorted by (value,
+    capture id).  dep_ok/ref_ok: per-capture-id participation flags.  Rows flagged
+    for neither side are dropped before the quadratic emission — THE saving of this
+    strategy over AllAtOnce.  Returns merged host arrays (dep, ref, cnt).
+    """
+    row_keep = dep_ok[line_cap_h] | ref_ok[line_cap_h]
+    lv, lc = line_val_h[row_keep], line_cap_h[row_keep]
+    n = lv.shape[0]
+    if n == 0:
+        z = np.zeros(0, np.int64)
+        return z, z, z
+    dep_f_h = dep_ok[lc]
+    ref_f_h = ref_ok[lc]
+
+    starts = np.empty(n, bool)
+    starts[0] = True
+    starts[1:] = lv[1:] != lv[:-1]
+    line_start_rows = np.flatnonzero(starts)
+    line_lens = np.diff(np.append(line_start_rows, n)).astype(np.int64)
+    pairs_per_line = line_lens * (line_lens - 1)
+    if stats is not None:
+        stats[stat_key] = int(pairs_per_line.sum())
+        stats["total_pairs"] = stats.get("total_pairs", 0) + stats[stat_key]
+    if int(pairs_per_line.sum()) == 0:
+        z = np.zeros(0, np.int64)
+        return z, z, z
+    pos_h = (np.arange(n, dtype=np.int64)
+             - np.repeat(line_start_rows, line_lens)).astype(np.int32)
+    len_h = np.repeat(line_lens, line_lens).astype(np.int32)
+
+    bounds = allatonce._chunk_boundaries(pairs_per_line, budget)
+    parts_d, parts_r, parts_c = [], [], []
+    pad = allatonce._pad_np
+    for bi in range(len(bounds) - 1):
+        lo_line, hi_line = bounds[bi], bounds[bi + 1]
+        if lo_line == hi_line:
+            continue
+        rs = int(line_start_rows[lo_line])
+        re = int(line_start_rows[hi_line]) if hi_line < len(line_start_rows) else n
+        chunk_pairs = int(pairs_per_line[lo_line:hi_line].sum())
+        if chunk_pairs == 0:
+            continue
+        row_cap = segments.pow2_capacity(re - rs)
+        pair_cap = segments.pow2_capacity(chunk_pairs)
+        d, r, c, n_out = _stage_pair_counts_masked(
+            jnp.asarray(pad(lc[rs:re], row_cap, SENTINEL)),
+            jnp.asarray(pad(dep_f_h[rs:re], row_cap, False)),
+            jnp.asarray(pad(ref_f_h[rs:re], row_cap, False)),
+            jnp.asarray(pad(pos_h[rs:re], row_cap, 0)),
+            jnp.asarray(pad(len_h[rs:re], row_cap, 1)),
+            jnp.asarray(pad(
+                (np.arange(rs, re, dtype=np.int32) - pos_h[rs:re]) - rs, row_cap, 0)),
+            capacity=pair_cap)
+        n_out = int(n_out)
+        parts_d.append(np.asarray(d)[:n_out])
+        parts_r.append(np.asarray(r)[:n_out])
+        parts_c.append(np.asarray(c)[:n_out])
+
+    if not parts_d:
+        z = np.zeros(0, np.int64)
+        return z, z, z
+    d = np.concatenate(parts_d).astype(np.int64)
+    r = np.concatenate(parts_r).astype(np.int64)
+    c = np.concatenate(parts_c).astype(np.int64)
+    # Host merge across chunks (the reduceGroup side of IntersectCindCandidates).
+    key = (d << 32) | r
+    uniq, inv = np.unique(key, return_inverse=True)
+    cnt = np.bincount(inv, weights=c, minlength=len(uniq)).astype(np.int64)
+    return (uniq >> 32), (uniq & 0xFFFFFFFF), cnt
+
+
+# ---------------------------------------------------------------------------
+# Host-side candidate generation (the Generate*/Infer* group-reduces).
+# ---------------------------------------------------------------------------
+
+def _np_group_pairs(group_key: np.ndarray):
+    """All ordered (i, j), i != j pairs of row indices within equal-key runs.
+
+    `group_key` must be sorted.  Same rotation layout as ops/pairs.py, on host.
+    """
+    n = group_key.shape[0]
+    if n == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    starts = np.empty(n, bool)
+    starts[0] = True
+    starts[1:] = group_key[1:] != group_key[:-1]
+    start_rows = np.flatnonzero(starts)
+    lens = np.diff(np.append(start_rows, n)).astype(np.int64)
+    length = np.repeat(lens, lens)
+    start_idx = np.repeat(start_rows, lens)
+    pos = np.arange(n, dtype=np.int64) - start_idx
+    reps = length - 1
+    total = int(reps.sum())
+    if total == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    row = np.repeat(np.arange(n, dtype=np.int64), reps)
+    k = np.arange(total, dtype=np.int64) - np.repeat(np.cumsum(reps) - reps, reps)
+    partner = start_idx[row] + (pos[row] + k + 1) % length[row]
+    return row, partner
+
+
+def _merge_refs(code_i, v_i, code_j, v_j):
+    """Canonical merged binary capture from two unary captures (lower code first).
+
+    Callers guarantee code_i < code_j, equal secondary, disjoint primaries, so v_i
+    belongs to the lower condition field — canonical (field-ascending) value order,
+    as in GenerateXxxBinaryCindCandidates.scala:44-58.
+    """
+    return code_i | code_j, v_i, v_j
+
+
+def _mergeable(code_a, code_b):
+    """Two unary captures can merge into a valid binary capture."""
+    return ((cc.secondary(code_a) == cc.secondary(code_b))
+            & (cc.primary(code_a) != cc.primary(code_b)))
+
+
+def _generate_x2_candidates(dep_cols, ref_code, ref_v1):
+    """x/2 candidates from CINDs sharing a dependent capture.
+
+    dep_cols: tuple of arrays identifying the dep (id or code+values); ref_code/
+    ref_v1: unary referenced captures.  Returns per-candidate (dep_row_index,
+    merged_ref_code, ref_v1, ref_v2) following GenerateXxxBinaryCindCandidates'
+    pair phase.  Refinements are family-specific (callers).
+    """
+    n = ref_code.shape[0]
+    if n == 0:
+        return (np.zeros(0, np.int64),) * 4
+    order = np.lexsort(tuple(reversed((*dep_cols, ref_code, ref_v1))))
+    dep_sorted = tuple(cix[order] for cix in dep_cols)
+    rc, rv = ref_code[order], ref_v1[order]
+    gkey = np.zeros(n, np.int64)
+    for cix in dep_sorted:
+        gkey = gkey * (int(cix.max(initial=0)) + 2) + (cix + 1)
+    i, j = _np_group_pairs(gkey)
+    keep = (rc[i] < rc[j]) & _mergeable(rc[i], rc[j])
+    i, j = i[keep], j[keep]
+    mcode, mv1, mv2 = _merge_refs(rc[i], rv[i], rc[j], rv[j])
+    return order[i], mcode, mv1, mv2
+
+
+def _lookup_capture_ids(cap_code, cap_v1, cap_v2, q_code, q_v1, q_v2):
+    """Ids of query captures in the canonical capture table; -1 when absent."""
+    table = np.stack([cap_code, cap_v1, cap_v2], axis=1).astype(np.int64)
+    query = np.stack([q_code, q_v1, q_v2], axis=1).astype(np.int64)
+    allr = np.concatenate([table, query])
+    uniq, inv = np.unique(allr, axis=0, return_inverse=True)
+    pos = np.full(len(uniq), -1, np.int64)
+    pos[inv[:len(table)]] = np.arange(len(table))
+    return pos[inv[len(table):]]
+
+
+def _semi_join(dep, ref, cnt, cand_dep, cand_ref):
+    """Keep (dep, ref, cnt) rows whose (dep, ref) is in the candidate pair set."""
+    if len(cand_dep) == 0 or len(dep) == 0:
+        z = np.zeros(0, np.int64)
+        return z, z, z
+    keys = (dep.astype(np.int64) << 32) | ref.astype(np.int64)
+    cand = np.unique((cand_dep.astype(np.int64) << 32) | cand_ref.astype(np.int64))
+    keep = np.isin(keys, cand, assume_unique=False)
+    return dep[keep], ref[keep], cnt[keep]
+
+
+# ---------------------------------------------------------------------------
+# The strategy.
+# ---------------------------------------------------------------------------
+
+def discover(triples, min_support: int, projections: str = "spo",
+             use_frequent_condition_filter: bool = True,
+             use_association_rules: bool = False,
+             clean_implied: bool = False,
+             pair_chunk_budget: int = allatonce.PAIR_CHUNK_BUDGET,
+             stats: dict | None = None) -> CindTable:
+    """Discover CINDs level by level (SmallToLargeTraversalStrategy semantics).
+
+    With clean_implied=True and no association rules the output equals
+    allatonce.discover(clean_implied=True); raw output follows the reference's
+    S2L, including its AR-before-generation ordering (see module docstring).
+    """
+    triples = np.asarray(triples, np.int32)
+    n = triples.shape[0]
+    if n == 0 or not any(ch in projections for ch in "spo"):
+        return CindTable.empty()
+    min_support = max(int(min_support), 1)
+    use_ars = use_association_rules and use_frequent_condition_filter
+
+    # --- Shared phase A: join lines + capture table + exact capture filter.
+    cap_n = segments.pow2_capacity(n)
+    padded = jnp.asarray(np.pad(triples, ((0, cap_n - n), (0, 0)),
+                                constant_values=np.iinfo(np.int32).max))
+    (line_val, line_cap, n_rows, cap_code_d, cap_v1_d, cap_v2_d, num_caps) = \
+        allatonce._stage_candidates(padded, jnp.int32(n), jnp.int32(min_support),
+                                    projections=projections,
+                                    use_fc_filter=use_frequent_condition_filter,
+                                    use_ars=use_ars)
+    n_rows = int(n_rows)
+    if n_rows == 0:
+        return CindTable.empty()
+    cap_l = segments.pow2_capacity(n_rows)
+    pad = allatonce._pad_np
+    line_val, line_cap, n_keep, dep_count_d = allatonce._stage_capture_filter(
+        jnp.asarray(pad(np.asarray(line_val), cap_l, SENTINEL)),
+        jnp.asarray(pad(np.asarray(line_cap), cap_l, SENTINEL)),
+        jnp.int32(n_rows), jnp.int32(min_support))
+    n_keep = int(n_keep)
+    num_caps = int(num_caps)
+    if n_keep == 0 or num_caps == 0:
+        return CindTable.empty()
+
+    line_val_h = np.asarray(line_val)[:n_keep]  # int32: device round-trips stay narrow
+    line_cap_h = np.asarray(line_cap)[:n_keep]
+    cap_code = np.asarray(cap_code_d)[:num_caps].astype(np.int64)
+    cap_v1 = np.asarray(cap_v1_d)[:num_caps].astype(np.int64)
+    cap_v2 = np.asarray(cap_v2_d)[:num_caps].astype(np.int64)
+    dep_count = np.asarray(dep_count_d)[:num_caps].astype(np.int64)
+    unary = np.asarray(cc.is_unary(cap_code))
+    binary = np.asarray(cc.is_binary(cap_code))
+    if stats is not None:
+        stats.update(n_triples=n, n_line_rows=n_rows, n_frequent_rows=n_keep,
+                     n_captures=num_caps, total_pairs=0)
+
+    rules = (frequency.mine_association_rules(triples, min_support)
+             if use_ars else None)
+    if use_ars and stats is not None:
+        stats["association_rules"] = rules  # driver --ar-output reuses these
+
+    # --- Level 1/1: unary-unary overlaps (findFrequentSingleSingleConditionOverlaps).
+    d11, r11, c11cnt = _chunked_cooc(line_val_h, line_cap_h, unary, unary,
+                                     pair_chunk_budget, stats, "pairs_11")
+    # Frequent overlaps only (findFrequentUnaryUnaryOverlapsDirectly's
+    # rhs-count filter); lhs frequency is guaranteed by the capture filter.
+    freq_ov = c11cnt >= min_support
+    is_cind_11 = c11cnt == dep_count[d11]
+    cind11_d, cind11_r = d11[is_cind_11], r11[is_cind_11]
+    cind11_sup = c11cnt[is_cind_11]
+    if use_ars:
+        keep = ~frequency.ar_implied_pair_mask(
+            cap_code[cind11_d], cap_code[cind11_r],
+            cap_v1[cind11_d], cap_v1[cind11_r], rules)
+        cind11_d, cind11_r, cind11_sup = (cind11_d[keep], cind11_r[keep],
+                                          cind11_sup[keep])
+    prop = freq_ov & ~is_cind_11
+    prop_d, prop_r, prop_cnt = d11[prop], r11[prop], c11cnt[prop]
+    if stats is not None:
+        stats.update(n_cinds_11=len(cind11_d), n_proper_overlaps=len(prop_d))
+
+    # --- Level 1/2 (findSingleDoubleCinds).
+    dep_idx, mcode, mv1, mv2 = _generate_x2_candidates(
+        (cind11_d,), cap_code[cind11_r].astype(np.int64), cap_v1[cind11_r])
+    c12_cand_dep = cind11_d[dep_idx]
+    # Refinement: trivial 1/1 merge — d < r  =>  candidate d < merge(d, r)
+    # (GenerateUnaryBinaryCindCandidates.scala:17-45).
+    dcode, rcode = cap_code[cind11_d], cap_code[cind11_r]
+    refn = _mergeable(dcode, rcode)
+    lo_is_dep = cc.primary(dcode) < cc.primary(rcode)
+    ref_mcode = np.where(refn, dcode | rcode, 0)
+    ref_mv1 = np.where(lo_is_dep, cap_v1[cind11_d], cap_v1[cind11_r])
+    ref_mv2 = np.where(lo_is_dep, cap_v1[cind11_r], cap_v1[cind11_d])
+    c12_cand_dep = np.concatenate([c12_cand_dep, cind11_d[refn]])
+    mcode = np.concatenate([mcode, ref_mcode[refn]])
+    mv1 = np.concatenate([mv1, ref_mv1[refn]])
+    mv2 = np.concatenate([mv2, ref_mv2[refn]])
+    c12_cand_ref = _lookup_capture_ids(cap_code, cap_v1, cap_v2, mcode, mv1, mv2)
+    ok = c12_cand_ref >= 0  # merged capture exists (and is frequent)
+    c12_cand_dep, c12_cand_ref = c12_cand_dep[ok], c12_cand_ref[ok]
+    cind12_d, cind12_r, cind12_sup = _verify_level(
+        line_val_h, line_cap_h, c12_cand_dep, c12_cand_ref, num_caps, dep_count,
+        cap_code, cap_v1, cap_v2, min_support, pair_chunk_budget, stats, "pairs_12")
+
+    # --- Level 2/1 (findDoubleSingleCindSets): candidates from pairs of proper
+    # overlaps sharing the referenced capture (GenerateBinaryUnaryCindCandidates).
+    c21_cand_dep, c21_cand_ref = _generate_2x_deps(
+        prop_r, prop_d, cap_code, cap_v1, cap_v2, require_cind=None)
+    cind21_d, cind21_r, cind21_sup = _verify_level(
+        line_val_h, line_cap_h, c21_cand_dep, c21_cand_ref, num_caps, dep_count,
+        cap_code, cap_v1, cap_v2, min_support, pair_chunk_budget, stats, "pairs_21")
+
+    # --- Inferred non-minimal 2/1s (InferDoubleSingleCinds): pairs of {1/1 CINDs
+    # (marked), proper overlaps} on the same ref with >= 1 CIND.  Frequency of the
+    # merged dep is exact here (capture table membership), cf. module docstring.
+    inf_r = np.concatenate([cind11_r, prop_r])
+    inf_d = np.concatenate([cind11_d, prop_d])
+    inf_is_cind = np.concatenate([np.ones(len(cind11_d), bool),
+                                  np.zeros(len(prop_d), bool)])
+    inf21_dep, inf21_ref = _generate_2x_deps(
+        inf_r, inf_d, cap_code, cap_v1, cap_v2, require_cind=inf_is_cind)
+    all21_dep = np.concatenate([cind21_d, inf21_dep])
+    all21_ref = np.concatenate([cind21_r, inf21_ref])
+
+    # --- Level 2/2 (findDoubleDoubleCindSets).
+    dep_idx, mcode, mv1, mv2 = _generate_x2_candidates(
+        (all21_dep,), cap_code[all21_ref].astype(np.int64), cap_v1[all21_ref])
+    c22_cand_dep = all21_dep[dep_idx]
+    # Refinement: 2/1 with ref a value-substituted subcapture of dep
+    # (GenerateBinaryBinaryCindCandidates.scala:20-42).
+    dcode, rcode = cap_code[all21_dep], cap_code[all21_ref]
+    refn = np.asarray(cc.is_subcode(cc.primary(rcode), cc.primary(dcode))) \
+        & (cc.secondary(rcode) == cc.secondary(dcode))
+    first_is_ref = cc.first_subcapture(dcode) == rcode
+    ref_mv1 = np.where(first_is_ref, cap_v1[all21_ref], cap_v1[all21_dep])
+    ref_mv2 = np.where(first_is_ref, cap_v2[all21_dep], cap_v1[all21_ref])
+    c22_cand_dep = np.concatenate([c22_cand_dep, all21_dep[refn]])
+    mcode = np.concatenate([mcode, dcode[refn]])
+    mv1 = np.concatenate([mv1, ref_mv1[refn]])
+    mv2 = np.concatenate([mv2, ref_mv2[refn]])
+    c22_cand_ref = _lookup_capture_ids(cap_code, cap_v1, cap_v2, mcode, mv1, mv2)
+    ok = c22_cand_ref >= 0
+    c22_cand_dep, c22_cand_ref = c22_cand_dep[ok], c22_cand_ref[ok]
+    # Drop self-pairs and pairs implied per Condition.isImpliedBy (incl. the
+    # equal-code quirk) — the evidence extractors never emit those.
+    ok = ~_implied_mask(c22_cand_dep, c22_cand_ref, cap_code, cap_v1, cap_v2)
+    c22_cand_dep, c22_cand_ref = c22_cand_dep[ok], c22_cand_ref[ok]
+    # Prune candidates implied by a 1/2 CIND (intended semantics of
+    # PruneNonMinimalDoubleDoubleCindCandidates — see module docstring).
+    keep = _prune_22_vs_12(c22_cand_dep, c22_cand_ref, cind12_d, cind12_r,
+                           cap_code, cap_v1, cap_v2)
+    c22_cand_dep, c22_cand_ref = c22_cand_dep[keep], c22_cand_ref[keep]
+    cind22_d, cind22_r, cind22_sup = _verify_level(
+        line_val_h, line_cap_h, c22_cand_dep, c22_cand_ref, num_caps, dep_count,
+        cap_code, cap_v1, cap_v2, min_support, pair_chunk_budget, stats, "pairs_22")
+
+    if stats is not None:
+        stats.update(n_cinds_12=len(cind12_d), n_cinds_21=len(cind21_d),
+                     n_inferred_21=len(inf21_dep), n_cinds_22=len(cind22_d))
+
+    # --- Assemble.
+    all_d = np.concatenate([cind11_d, cind12_d, cind21_d, cind22_d])
+    all_r = np.concatenate([cind11_r, cind12_r, cind21_r, cind22_r])
+    all_s = np.concatenate([cind11_sup, cind12_sup, cind21_sup, cind22_sup])
+    table = CindTable(
+        dep_code=cap_code[all_d], dep_v1=cap_v1[all_d], dep_v2=cap_v2[all_d],
+        ref_code=cap_code[all_r], ref_v1=cap_v1[all_r], ref_v2=cap_v2[all_r],
+        support=all_s)
+    if clean_implied:
+        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+    return table
+
+
+def _generate_2x_deps(group_ref, member_dep, cap_code, cap_v1, cap_v2,
+                      require_cind):
+    """2/x dep-candidates: pairs of unary captures sharing a referenced capture.
+
+    group_ref/member_dep: directed (dep, ref) pairs (capture ids) to group by ref.
+    require_cind: None (all pairs; GenerateBinaryUnaryCindCandidates) or a bool
+    array marking 1/1 CINDs, pairs needing >= 1 mark (InferDoubleSingleCinds).
+    Returns (merged_dep_id, ref_id) for merged deps present in the capture table.
+    """
+    m = len(group_ref)
+    if m == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    dcode = cap_code[member_dep]
+    order = np.lexsort((cap_v1[member_dep], dcode, group_ref))
+    gr, md = group_ref[order], member_dep[order]
+    dc = dcode[order]
+    marks = require_cind[order] if require_cind is not None else None
+    i, j = _np_group_pairs(gr)
+    keep = (dc[i] < dc[j]) & _mergeable(dc[i], dc[j])
+    if marks is not None:
+        keep &= marks[i] | marks[j]
+    i, j = i[keep], j[keep]
+    mcode = dc[i] | dc[j]
+    mv1, mv2 = cap_v1[md[i]], cap_v1[md[j]]
+    dep_ids = _lookup_capture_ids(cap_code, cap_v1, cap_v2, mcode, mv1, mv2)
+    ok = dep_ids >= 0  # merged dep exists and is frequent (exact capture support)
+    out_dep, out_ref = dep_ids[ok], gr[i][ok]
+    if len(out_dep) == 0:
+        return out_dep, out_ref
+    both = np.unique(np.stack([out_dep, out_ref], axis=1), axis=0)
+    return both[:, 0], both[:, 1]
+
+
+def _verify_level(line_val_h, line_cap_h, cand_dep, cand_ref, num_caps, dep_count,
+                  cap_code, cap_v1, cap_v2, min_support, budget, stats, stat_key):
+    """Verify candidate (dep, ref) pairs against the join lines by counting.
+
+    CIND iff cooc(dep, ref) == |dep| (>= min_support by the capture filter).
+    Replaces Extract*CindCandidates + IntersectCindCandidates + support filters.
+    """
+    if len(cand_dep) == 0:
+        z = np.zeros(0, np.int64)
+        return z, z, z
+    dep_ok = np.zeros(num_caps, bool)
+    dep_ok[cand_dep] = True
+    ref_ok = np.zeros(num_caps, bool)
+    ref_ok[cand_ref] = True
+    d, r, cnt = _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok, budget,
+                              stats, stat_key)
+    d, r, cnt = _semi_join(d, r, cnt, cand_dep, cand_ref)
+    is_cind = (cnt == dep_count[d]) & (dep_count[d] >= min_support)
+    is_cind &= ~_implied_mask(d, r, cap_code, cap_v1, cap_v2)
+    return d[is_cind], r[is_cind], dep_count[d[is_cind]]
+
+
+def _implied_mask(dep_id, ref_id, cap_code, cap_v1, cap_v2):
+    """Condition.isImpliedBy per pair of capture ids (same semantics as the
+    oracle's _implies, vectorized), including dep == ref."""
+    if len(dep_id) == 0:
+        return np.zeros(0, bool)
+    dcode, rcode = cap_code[dep_id], cap_code[ref_id]
+    same = dep_id == ref_id
+    sub = np.asarray(cc.is_subcode(rcode, dcode))
+    first = cc.first_subcapture(dcode) == rcode
+    vmatch = np.where(first, cap_v1[ref_id] == cap_v1[dep_id],
+                      cap_v1[ref_id] == cap_v2[dep_id])
+    return same | (sub & vmatch)
+
+
+def _prune_22_vs_12(cand_dep, cand_ref, cind12_d, cind12_r,
+                    cap_code, cap_v1, cap_v2):
+    """Keep 2/2 candidates NOT implied by any 1/2 CIND: implied when a 1/2 CIND
+    (a, ref) exists with a a value-matching unary subcapture of the candidate dep."""
+    if len(cand_dep) == 0:
+        return np.zeros(0, bool)
+    if len(cind12_d) == 0:
+        return np.ones(len(cand_dep), bool)
+    # 1/2 CINDs keyed by (ref_id, dep unary capture id).
+    cind_keys = np.unique((cind12_r.astype(np.int64) << 32)
+                          | cind12_d.astype(np.int64))
+    keep = np.ones(len(cand_dep), bool)
+    dcode = cap_code[cand_dep]
+    for sub_fn, val in ((cc.first_subcapture, cap_v1[cand_dep]),
+                        (cc.second_subcapture, cap_v2[cand_dep])):
+        sub_code = np.asarray(sub_fn(dcode))
+        sub_ids = _lookup_capture_ids(
+            cap_code, cap_v1, cap_v2, sub_code, val,
+            np.full(len(cand_dep), NO_VALUE, np.int64))
+        present = sub_ids >= 0
+        key = (cand_ref.astype(np.int64) << 32) | np.where(present, sub_ids, 0)
+        keep &= ~(present & np.isin(key, cind_keys))
+    return keep
+
+

@@ -107,6 +107,34 @@ def _stage_rules(triples, n_valid, min_support):
     return (*full_cols, n_rules)
 
 
+def ar_implied_pair_mask(dep_code, ref_code, dep_v1, ref_v1, mined_rules):
+    """True where a capture pair restates a mined perfect-confidence rule.
+
+    Host-side, shared by every strategy's AR post-filter
+    (FilterAssociationRuleImpliedCinds.scala:30-58): the suppressed pairs are
+    unary/unary with the same projection, whose (antecedent-field, consequent-field,
+    antecedent-value, consequent-value) matches a rule.
+    """
+    dep_code = np.asarray(dep_code)
+    ref_code = np.asarray(ref_code)
+    out = np.zeros(len(dep_code), bool)
+    ants, cons, avs, cvs, _ = mined_rules
+    if len(ants) == 0 or len(dep_code) == 0:
+        return out
+    rules = set(zip(ants.tolist(), cons.tolist(), avs.tolist(), cvs.tolist()))
+    cand = np.asarray(cc.is_unary(dep_code) & cc.is_unary(ref_code)
+                      & (cc.secondary(dep_code) == cc.secondary(ref_code))
+                      & (cc.primary(dep_code) != cc.primary(ref_code)))
+    dep_v1 = np.asarray(dep_v1)
+    ref_v1 = np.asarray(ref_v1)
+    for i in np.flatnonzero(cand):
+        key = (int(cc.primary(int(dep_code[i]))), int(cc.primary(int(ref_code[i]))),
+               int(dep_v1[i]), int(ref_v1[i]))
+        if key in rules:
+            out[i] = True
+    return out
+
+
 def mine_association_rules(triples_np, min_support: int):
     """Host wrapper: (N, 3) int32 -> numpy rule table (ant_bit, cons_bit, ant_val,
     cons_val, support)."""

@@ -19,7 +19,7 @@ from .. import oracle
 from ..data import CindTable
 from ..dictionary import Dictionary, intern_triples
 from ..io import ntriples, prefixes, reader
-from ..models import allatonce, sharded
+from ..models import allatonce, sharded, small_to_large
 from ..parallel.mesh import make_mesh
 
 
@@ -235,7 +235,7 @@ def _not_implemented_strategy(name, fallback):
 # 1 = small-to-large (default), 2 = approximate all-at-once, 3 = late-BB.
 STRATEGIES = {
     0: allatonce.discover,
-    1: _not_implemented_strategy("small-to-large", allatonce.discover),
+    1: small_to_large.discover,
     2: _not_implemented_strategy("approximate-all-at-once", allatonce.discover),
     3: _not_implemented_strategy("late-bb", allatonce.discover),
 }

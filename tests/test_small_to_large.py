@@ -1,0 +1,128 @@
+"""SmallToLarge strategy tests: raw S2L semantics + clean-implied equivalence.
+
+The raw-output oracle encodes the reference's S2L result set (see
+models/small_to_large.py docstring): all 1/1 and 1/2 CINDs, 2/1 CINDs whose dep
+subcaptures are both proper overlaps of the ref, and 2/2 CINDs not implied by a
+1/2 CIND.  With clean_implied, S2L and AllAtOnce must agree exactly.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from rdfind_tpu import conditions as cc
+from rdfind_tpu import oracle
+from rdfind_tpu.data import NO_VALUE
+from rdfind_tpu.dictionary import intern_triples
+from rdfind_tpu.models import allatonce, small_to_large
+
+from test_allatonce import canon, oracle_rows, random_triples
+
+
+def run_s2l(triples, min_support, **kw):
+    ids, dct = intern_triples(np.asarray(triples, dtype=object))
+    table = small_to_large.discover(ids, min_support, **kw)
+    out = set()
+    for c in table.decoded(dct):
+        out.add((c.dep_code, c.dep_v1, c.dep_v2 if c.dep_v2 is not None else -1,
+                 c.ref_code, c.ref_v1, c.ref_v2 if c.ref_v2 is not None else -1,
+                 c.support))
+    return out
+
+
+def s2l_raw_oracle(triples, min_support, projections="spo"):
+    """Reference-faithful raw S2L output, derived from the definitional CIND set."""
+    full = oracle.discover_cinds_definitional(triples, min_support, projections)
+    cind_pairs = {(c[0:3], c[3:6]) for c in full}
+    c12_pairs = {(dep, ref) for dep, ref in cind_pairs
+                 if cc.is_unary(dep[0]) and cc.is_binary(ref[0])}
+
+    def subcaptures(cap):
+        code, v1, v2 = cap
+        return ((cc.first_subcapture(code), v1, NO_VALUE),
+                (cc.second_subcapture(code), v2, NO_VALUE))
+
+    out = set()
+    for c in full:
+        dep, ref = c[0:3], c[3:6]
+        dep_bin, ref_bin = cc.is_binary(dep[0]), cc.is_binary(ref[0])
+        if not dep_bin:
+            out.add(c)  # 1/1 and 1/2 kept in full
+        elif not ref_bin:
+            # 2/1 kept only when both dep subcaptures are PROPER overlaps of ref,
+            # i.e. neither (sub, ref) is itself a CIND.
+            if all((sub, ref) not in cind_pairs for sub in subcaptures(dep)):
+                out.add(c)
+        else:
+            # 2/2 kept unless implied by a 1/2 CIND via a dep subcapture.
+            if all((sub, ref) not in c12_pairs for sub in subcaptures(dep)):
+                out.add(c)
+    return {(c[0], c[1], -1 if c[2] == oracle.NO_VALUE else c[2],
+             c[3], c[4], -1 if c[5] == oracle.NO_VALUE else c[5], c[6])
+            for c in out}
+
+
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("min_support", [1, 2, 4])
+def test_s2l_raw_matches_oracle(seed, min_support):
+    rng = random.Random(seed)
+    triples = random_triples(rng, 90, 6, 3, 5)
+    got = run_s2l(triples, min_support)
+    want = s2l_raw_oracle(triples, min_support)
+    assert canon(got) == canon(want)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_s2l_clean_implied_equals_allatonce(seed):
+    rng = random.Random(100 + seed)
+    triples = random_triples(rng, 80, 5, 3, 4)
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    s2l = small_to_large.discover(ids, 2, clean_implied=True)
+    aao = allatonce.discover(ids, 2, clean_implied=True)
+    assert s2l.to_rows() == aao.to_rows()
+
+
+@pytest.mark.parametrize("projections", ["s", "o", "sp", "spo"])
+def test_s2l_projections(projections):
+    rng = random.Random(11)
+    triples = random_triples(rng, 70, 5, 3, 4)
+    got = run_s2l(triples, 2, projections=projections)
+    want = s2l_raw_oracle(triples, 2, projections=projections)
+    assert canon(got) == canon(want)
+
+
+def test_s2l_fc_filter_invariant():
+    rng = random.Random(3)
+    triples = random_triples(rng, 120, 7, 3, 6)
+    with_f = run_s2l(triples, 3, use_frequent_condition_filter=True)
+    without_f = run_s2l(triples, 3, use_frequent_condition_filter=False)
+    assert canon(with_f) == canon(without_f)
+
+
+def test_s2l_skewed_data_chunked():
+    # A hub join value forces many captures into one line; exercise chunking.
+    rng = random.Random(7)
+    triples = [("hub", f"p{i % 3}", f"o{i}") for i in range(40)]
+    triples += random_triples(rng, 60, 4, 3, 4)
+    got = run_s2l(triples, 2, pair_chunk_budget=1 << 8)
+    want = s2l_raw_oracle(triples, 2)
+    assert canon(got) == canon(want)
+
+
+def test_s2l_empty_and_tiny():
+    assert run_s2l([], 2) == set()
+    assert run_s2l([("a", "b", "c")], 1) == s2l_raw_oracle([("a", "b", "c")], 1)
+
+
+def test_s2l_stats_reduction():
+    # S2L's restricted emission must check no more pairs than AllAtOnce's full
+    # quadratic on the same data (usually far fewer).
+    rng = random.Random(5)
+    triples = random_triples(rng, 200, 8, 4, 6)
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    s_aao, s_s2l = {}, {}
+    allatonce.discover(ids, 3, stats=s_aao)
+    small_to_large.discover(ids, 3, stats=s_s2l)
+    assert s_s2l["pairs_11"] <= s_aao["total_pairs"]
+    assert s_s2l["total_pairs"] > 0
