@@ -189,6 +189,54 @@ def fused_step(triples, n_valid, min_support, *, projections="spo",
             s_out, n_out, overflow)
 
 
+def prepare_join_lines(triples, min_support, projections,
+                       use_frequent_condition_filter, use_ars, stats):
+    """Shared phase A of every strategy: join-line rows + capture table.
+
+    Runs _stage_candidates + _stage_capture_filter and pulls the results to host.
+    Returns None when the plan is trivially empty, else a dict with the triples,
+    the (value, capture)-sorted frequent join-line rows, the canonical capture
+    table columns, per-capture exact supports, and num_caps.
+    """
+    triples = np.asarray(triples, np.int32)
+    n = triples.shape[0]
+    if n == 0 or not any(ch in projections for ch in "spo"):
+        return None
+    cap_n = segments.pow2_capacity(n)
+    padded = jnp.asarray(np.pad(triples, ((0, cap_n - n), (0, 0)),
+                                constant_values=np.iinfo(np.int32).max))
+    (line_val, line_cap, n_rows, cap_code_d, cap_v1_d, cap_v2_d, num_caps) = \
+        _stage_candidates(padded, jnp.int32(n), jnp.int32(min_support),
+                          projections=projections,
+                          use_fc_filter=use_frequent_condition_filter,
+                          use_ars=use_ars)
+    n_rows = int(n_rows)
+    if n_rows == 0:
+        return None
+    cap_l = segments.pow2_capacity(n_rows)
+    line_val, line_cap, n_keep, dep_count_d = _stage_capture_filter(
+        jnp.asarray(_pad_np(np.asarray(line_val), cap_l, SENTINEL)),
+        jnp.asarray(_pad_np(np.asarray(line_cap), cap_l, SENTINEL)),
+        jnp.int32(n_rows), jnp.int32(min_support))
+    n_keep = int(n_keep)
+    num_caps = int(num_caps)
+    if n_keep == 0 or num_caps == 0:
+        return None
+    state = dict(
+        triples=triples,
+        line_val_h=np.asarray(line_val)[:n_keep],
+        line_cap_h=np.asarray(line_cap)[:n_keep],
+        cap_code=np.asarray(cap_code_d)[:num_caps].astype(np.int64),
+        cap_v1=np.asarray(cap_v1_d)[:num_caps].astype(np.int64),
+        cap_v2=np.asarray(cap_v2_d)[:num_caps].astype(np.int64),
+        dep_count=np.asarray(dep_count_d)[:num_caps].astype(np.int64),
+        num_caps=num_caps)
+    if stats is not None:
+        stats.update(n_triples=n, n_line_rows=n_rows, n_frequent_rows=n_keep,
+                     n_captures=num_caps, total_pairs=0)
+    return state
+
+
 def filter_ar_implied_cinds(table: CindTable, mined_rules) -> CindTable:
     """Drop 1/1 CIND pairs that restate a perfect-confidence association rule.
 

@@ -1,0 +1,178 @@
+"""ApproximateAllAtOnce traversal strategy (the reference's id 2).
+
+Two rounds, exact end-to-end (plan/ApproximateAllAtOnceTraversalStrategy.scala:
+27-114):
+
+  round 1 — instead of materializing every co-occurrence pair (AllAtOnce), build a
+      fixed-width Bloom **refset sketch per dependent capture**: OR the hash bits of
+      each join line's captures into a line Bloom, then AND the line Blooms over
+      every line containing the dependent (ops/sketch.py).  The AND of Blooms is a
+      conservative superset of the Bloom of the exact refset intersection — the
+      same guarantee the reference gets from BloomFilter.intersect
+      (IntersectHalfApproximateCindCandidates.scala:40-44).
+  candidate generation — "which captures r could be in dep d's refset" is answered
+      for all (d, r) at once by the bitset-containment **matmul on the MXU**
+      (sketch.contains_matrix), tiled over dependents.
+  round 2 — exact verification by co-occurrence counting restricted to candidate
+      pairs: rows whose capture is neither a candidate dep nor a candidate ref are
+      dropped before the quadratic pair emission, surviving pairs are semi-joined
+      against the candidate set, and the CIND test cooc(d, r) == |d| runs on exact
+      counts (mirrors the re-evaluation round, CreateApproximatedCindCandidates
+      .scala:59-163, without its small-join-line skip: counting needs every line).
+
+Design difference vs. the reference, on purpose: the reference keeps small refsets
+exact in round 1 and sketches only those above `--exactness-threshold`; here row-1
+state is one fixed-shape sketch matrix for ALL dependents (num_caps × bits), which
+is the TPU-friendly layout (static shapes, scatter/matmul, no per-evidence variable
+width).  False positives cost only round-2 verification work, never correctness, so
+raw output is identical to raw AllAtOnce (differential-tested).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import oracle
+from ..data import CindTable
+from ..ops import frequency, segments, sketch
+from . import allatonce, small_to_large
+
+SENTINEL = segments.SENTINEL
+
+DEP_TILE = 1 << 12
+
+
+def _build_sketches(line_val_h, line_cap_h, num_caps, *, bits, num_hashes,
+                    row_budget=sketch.BUILD_ROW_BUDGET):
+    """Packed (num_caps, bits//32) refset sketches from host join-line rows.
+
+    Rows arrive sorted by (join value, capture).  Line Blooms are built per
+    line-aligned chunk; dependent sketches are AND-accumulated across chunks (a
+    capture's rows may span chunks), packed-AND on host between device stages.
+    """
+    n = line_val_h.shape[0]
+    starts = np.empty(n, bool)
+    starts[0] = True
+    starts[1:] = line_val_h[1:] != line_val_h[:-1]
+    line_gid = np.cumsum(starts, dtype=np.int64) - 1
+    line_start_rows = np.flatnonzero(starts)
+    num_lines = len(line_start_rows)
+
+    sketches = np.full((num_caps, bits // 32), 0xFFFFFFFF, np.uint32)
+    # Chunk over whole lines so each line's Bloom is complete within its chunk.
+    chunk_first_line = 0
+    while chunk_first_line < num_lines:
+        last = chunk_first_line
+        rs = int(line_start_rows[chunk_first_line])
+        while last < num_lines:
+            re = (int(line_start_rows[last + 1]) if last + 1 < num_lines else n)
+            if re - rs > row_budget and last > chunk_first_line:
+                break
+            last += 1
+        re = int(line_start_rows[last]) if last < num_lines else n
+        rows = slice(rs, re)
+        m = re - rs
+        row_cap = segments.pow2_capacity(m)
+        lines_cap = segments.pow2_capacity(last - chunk_first_line)
+        pad = allatonce._pad_np
+        gid_local = (line_gid[rows] - chunk_first_line).astype(np.int32)
+        cap_local = line_cap_h[rows]
+        valid = jnp.arange(row_cap, dtype=jnp.int32) < m
+        blooms = sketch.build_line_blooms(
+            jnp.asarray(pad(gid_local, row_cap, 0)),
+            jnp.asarray(pad(cap_local, row_cap, 0)), valid,
+            num_lines=lines_cap, bits=bits, num_hashes=num_hashes)
+        part = sketch.intersect_dep_sketches(
+            jnp.asarray(pad(cap_local, row_cap, 0)),
+            blooms[jnp.asarray(pad(gid_local, row_cap, 0))], valid,
+            num_caps=num_caps, bits=bits)
+        sketches &= np.asarray(part)
+        chunk_first_line = last
+    return sketches
+
+
+def _candidate_pairs(sketches, num_caps, *, bits, num_hashes,
+                     dep_mask=None, ref_mask=None, dep_tile=DEP_TILE):
+    """All (dep, ref) capture-id pairs passing the sketch test, dep != ref.
+
+    Tiled over dependents; each tile is one MXU containment matmul.  Optional
+    dep_mask/ref_mask restrict either side (used by the LateBB rounds).
+    """
+    ref_ids = jnp.arange(num_caps, dtype=jnp.int32)
+    ref_ok = jnp.asarray(ref_mask if ref_mask is not None
+                         else np.ones(num_caps, bool))
+    out_d, out_r = [], []
+    for lo in range(0, num_caps, dep_tile):
+        hi = min(lo + dep_tile, num_caps)
+        if dep_mask is not None and not dep_mask[lo:hi].any():
+            continue
+        tile = jnp.asarray(sketches[lo:hi])
+        cand = np.array(sketch.contains_matrix(
+            tile, ref_ids, ref_ok, bits=bits, num_hashes=num_hashes))
+        if dep_mask is not None:
+            cand &= dep_mask[lo:hi, None]
+        d, r = np.nonzero(cand)
+        d = d.astype(np.int64) + lo
+        r = r.astype(np.int64)
+        keep = d != r
+        out_d.append(d[keep])
+        out_r.append(r[keep])
+    if not out_d:
+        z = np.zeros(0, np.int64)
+        return z, z
+    return np.concatenate(out_d), np.concatenate(out_r)
+
+
+# Shared phase A lives with the staging code it drives.
+prepare_join_lines = allatonce.prepare_join_lines
+
+
+def discover(triples, min_support: int, projections: str = "spo",
+             use_frequent_condition_filter: bool = True,
+             use_association_rules: bool = False,
+             clean_implied: bool = False,
+             pair_chunk_budget: int = allatonce.PAIR_CHUNK_BUDGET,
+             sketch_bits: int = sketch.DEFAULT_BITS,
+             sketch_hashes: int = sketch.DEFAULT_HASHES,
+             stats: dict | None = None) -> CindTable:
+    """Discover all CINDs; raw output equals allatonce.discover's raw output."""
+    min_support = max(int(min_support), 1)
+    use_ars = use_association_rules and use_frequent_condition_filter
+    st = prepare_join_lines(triples, min_support, projections,
+                            use_frequent_condition_filter, use_ars, stats)
+    if st is None:
+        return CindTable.empty()
+
+    sketches = _build_sketches(st["line_val_h"], st["line_cap_h"],
+                               st["num_caps"], bits=sketch_bits,
+                               num_hashes=sketch_hashes)
+    # Infrequent captures were row-filtered out of the join lines: their sketches
+    # stay all-ones (empty AND) and they can appear in no CIND on either side —
+    # mask them out of candidate generation entirely.
+    frequent = st["dep_count"] >= min_support
+    cand_dep, cand_ref = _candidate_pairs(sketches, st["num_caps"],
+                                          bits=sketch_bits,
+                                          num_hashes=sketch_hashes,
+                                          dep_mask=frequent, ref_mask=frequent)
+    if stats is not None:
+        stats["n_sketch_candidates"] = len(cand_dep)
+
+    d, r, sup = small_to_large._verify_level(
+        st["line_val_h"], st["line_cap_h"], cand_dep, cand_ref, st["num_caps"],
+        st["dep_count"], st["cap_code"], st["cap_v1"], st["cap_v2"],
+        min_support, pair_chunk_budget, stats, "pairs_verify")
+
+    cap_code, cap_v1, cap_v2 = st["cap_code"], st["cap_v1"], st["cap_v2"]
+    table = CindTable(
+        dep_code=cap_code[d], dep_v1=cap_v1[d], dep_v2=cap_v2[d],
+        ref_code=cap_code[r], ref_v1=cap_v1[r], ref_v2=cap_v2[r],
+        support=sup)
+    if use_ars:
+        rules = frequency.mine_association_rules(st["triples"], min_support)
+        if stats is not None:
+            stats["association_rules"] = rules
+        table = allatonce.filter_ar_implied_cinds(table, rules)
+    if clean_implied:
+        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+    return table

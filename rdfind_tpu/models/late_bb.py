@@ -1,0 +1,104 @@
+"""LateBB traversal strategy (the reference's id 3).
+
+Two rounds over the join lines (plan/LateBBTraversalStrategy.scala:24-123):
+
+  round 1 — **unary dependents only**: build the per-dependent Bloom refset
+      sketches (shared with strategy 2), generate candidate refs for unary deps via
+      the MXU containment matmul, verify exactly by co-occurrence counting.  Yields
+      every 1/1 and 1/2 CIND (the reference's half-approximate
+      CreateAlmostAllHalfApproximateCindCandidates round, with our count-based
+      verification replacing its round-2 re-check — exact in one pass here).
+  round 2 — **binary dependents**, pruned by round 1's knowledge: a candidate
+      (d1∧d2 ⊆ r) whose value-matching unary subcapture already satisfies
+      (d1 ⊆ r) is implied and skipped before verification (the known-CIND pruning
+      of CreateApproximatedCindCandidates2.scala:151-170; its negative-count
+      "already counted" marker is unnecessary here because counting is one-shot).
+
+Raw output = raw AllAtOnce minus the non-minimal 2/x CINDs implied by a 1/x CIND
+on a value-substituted dep subcapture; with clean_implied both are the identical
+minimal set (differential-tested).  Association rules filter the final table only
+(same pairs AllAtOnce filters), not the round-1 prune set, so higher-family output
+never depends on AR pruning — unlike S2L's inherited AR-before-generation quirk.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import conditions as cc
+from .. import oracle
+from ..data import CindTable
+from ..ops import frequency, sketch
+from . import allatonce, approximate, small_to_large
+
+
+def discover(triples, min_support: int, projections: str = "spo",
+             use_frequent_condition_filter: bool = True,
+             use_association_rules: bool = False,
+             clean_implied: bool = False,
+             pair_chunk_budget: int = allatonce.PAIR_CHUNK_BUDGET,
+             sketch_bits: int = sketch.DEFAULT_BITS,
+             sketch_hashes: int = sketch.DEFAULT_HASHES,
+             stats: dict | None = None) -> CindTable:
+    """Discover CINDs in two rounds: unary dependents first, binary pruned after."""
+    min_support = max(int(min_support), 1)
+    use_ars = use_association_rules and use_frequent_condition_filter
+    st = approximate.prepare_join_lines(triples, min_support, projections,
+                                        use_frequent_condition_filter, use_ars,
+                                        stats)
+    if st is None:
+        return CindTable.empty()
+    cap_code, cap_v1, cap_v2 = st["cap_code"], st["cap_v1"], st["cap_v2"]
+    num_caps, dep_count = st["num_caps"], st["dep_count"]
+    unary = np.asarray(cc.is_unary(cap_code))
+
+    sketches = approximate._build_sketches(
+        st["line_val_h"], st["line_cap_h"], num_caps,
+        bits=sketch_bits, num_hashes=sketch_hashes)
+
+    # ONE containment pass for all frequent captures (the MXU matmul is the
+    # dominant cost — don't run it once per round), split by dep arity after.
+    frequent = dep_count >= min_support
+    cand_dep, cand_ref = approximate._candidate_pairs(
+        sketches, num_caps, bits=sketch_bits, num_hashes=sketch_hashes,
+        dep_mask=frequent, ref_mask=frequent)
+    dep_is_unary = unary[cand_dep]
+
+    # Round 1: unary dependents, refs of both arities.
+    c1_dep, c1_ref = cand_dep[dep_is_unary], cand_ref[dep_is_unary]
+    d1, r1, sup1 = small_to_large._verify_level(
+        st["line_val_h"], st["line_cap_h"], c1_dep, c1_ref, num_caps, dep_count,
+        cap_code, cap_v1, cap_v2, min_support, pair_chunk_budget, stats,
+        "pairs_round1")
+    if stats is not None:
+        stats.update(n_round1_candidates=len(c1_dep), n_round1_cinds=len(d1))
+
+    # Round 2: binary dependents, candidates pruned by round-1 CINDs — a
+    # candidate (d1^d2, r) with a known value-matching (d1, r) CIND is implied
+    # (same subcapture probe as S2L's 2/2-vs-1/2 prune, which is family-generic).
+    c2_dep, c2_ref = cand_dep[~dep_is_unary], cand_ref[~dep_is_unary]
+    keep = small_to_large._prune_22_vs_12(c2_dep, c2_ref, d1, r1,
+                                          cap_code, cap_v1, cap_v2)
+    c2_dep, c2_ref = c2_dep[keep], c2_ref[keep]
+    d2, r2, sup2 = small_to_large._verify_level(
+        st["line_val_h"], st["line_cap_h"], c2_dep, c2_ref, num_caps, dep_count,
+        cap_code, cap_v1, cap_v2, min_support, pair_chunk_budget, stats,
+        "pairs_round2")
+    if stats is not None:
+        stats.update(n_round2_candidates=len(c2_dep), n_round2_cinds=len(d2))
+
+    all_d = np.concatenate([d1, d2])
+    all_r = np.concatenate([r1, r2])
+    all_s = np.concatenate([sup1, sup2])
+    table = CindTable(
+        dep_code=cap_code[all_d], dep_v1=cap_v1[all_d], dep_v2=cap_v2[all_d],
+        ref_code=cap_code[all_r], ref_v1=cap_v1[all_r], ref_v2=cap_v2[all_r],
+        support=all_s)
+    if use_ars:
+        rules = frequency.mine_association_rules(st["triples"], min_support)
+        if stats is not None:
+            stats["association_rules"] = rules
+        table = allatonce.filter_ar_implied_cinds(table, rules)
+    if clean_implied:
+        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+    return table

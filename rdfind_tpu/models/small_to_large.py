@@ -262,47 +262,20 @@ def discover(triples, min_support: int, projections: str = "spo",
     allatonce.discover(clean_implied=True); raw output follows the reference's
     S2L, including its AR-before-generation ordering (see module docstring).
     """
-    triples = np.asarray(triples, np.int32)
-    n = triples.shape[0]
-    if n == 0 or not any(ch in projections for ch in "spo"):
-        return CindTable.empty()
     min_support = max(int(min_support), 1)
     use_ars = use_association_rules and use_frequent_condition_filter
 
     # --- Shared phase A: join lines + capture table + exact capture filter.
-    cap_n = segments.pow2_capacity(n)
-    padded = jnp.asarray(np.pad(triples, ((0, cap_n - n), (0, 0)),
-                                constant_values=np.iinfo(np.int32).max))
-    (line_val, line_cap, n_rows, cap_code_d, cap_v1_d, cap_v2_d, num_caps) = \
-        allatonce._stage_candidates(padded, jnp.int32(n), jnp.int32(min_support),
-                                    projections=projections,
-                                    use_fc_filter=use_frequent_condition_filter,
-                                    use_ars=use_ars)
-    n_rows = int(n_rows)
-    if n_rows == 0:
+    st = allatonce.prepare_join_lines(triples, min_support, projections,
+                                      use_frequent_condition_filter, use_ars,
+                                      stats)
+    if st is None:
         return CindTable.empty()
-    cap_l = segments.pow2_capacity(n_rows)
-    pad = allatonce._pad_np
-    line_val, line_cap, n_keep, dep_count_d = allatonce._stage_capture_filter(
-        jnp.asarray(pad(np.asarray(line_val), cap_l, SENTINEL)),
-        jnp.asarray(pad(np.asarray(line_cap), cap_l, SENTINEL)),
-        jnp.int32(n_rows), jnp.int32(min_support))
-    n_keep = int(n_keep)
-    num_caps = int(num_caps)
-    if n_keep == 0 or num_caps == 0:
-        return CindTable.empty()
-
-    line_val_h = np.asarray(line_val)[:n_keep]  # int32: device round-trips stay narrow
-    line_cap_h = np.asarray(line_cap)[:n_keep]
-    cap_code = np.asarray(cap_code_d)[:num_caps].astype(np.int64)
-    cap_v1 = np.asarray(cap_v1_d)[:num_caps].astype(np.int64)
-    cap_v2 = np.asarray(cap_v2_d)[:num_caps].astype(np.int64)
-    dep_count = np.asarray(dep_count_d)[:num_caps].astype(np.int64)
+    triples = st["triples"]
+    line_val_h, line_cap_h = st["line_val_h"], st["line_cap_h"]
+    cap_code, cap_v1, cap_v2 = st["cap_code"], st["cap_v1"], st["cap_v2"]
+    dep_count, num_caps = st["dep_count"], st["num_caps"]
     unary = np.asarray(cc.is_unary(cap_code))
-    binary = np.asarray(cc.is_binary(cap_code))
-    if stats is not None:
-        stats.update(n_triples=n, n_line_rows=n_rows, n_frequent_rows=n_keep,
-                     n_captures=num_caps, total_pairs=0)
 
     rules = (frequency.mine_association_rules(triples, min_support)
              if use_ars else None)

@@ -1,0 +1,178 @@
+"""Bitset sketches: the TPU-native form of the reference's extended Bloom filters.
+
+The reference's approximate strategies lean on a forked Guava library — BloomFilter
+with exportBits/wrap/intersect (CreateAllHalfApproximateCindCandidates.scala:110-116,
+IntersectHalfApproximateCindCandidates.scala:40-44) and SpectralBloomFilter, a
+counting filter (ExtractBalancedHalfApproximateUnaryUnaryOverlapCandidates.scala:
+24-37).  Here those become fixed-width **bitset rows in HBM**:
+
+  * a join line's capture set   -> one `bits`-wide Bloom row (scatter-OR build);
+  * a dependent's refset sketch -> bitwise AND of the Bloom rows of every join line
+    containing the dependent (segment-AND) — a conservative superset of the exact
+    refset, because AND of Blooms ⊇ Bloom of the intersection;
+  * candidate generation        -> "are all k hash bits of capture r set in
+    sketch[d]?" for every (d, r) at once, phrased as a bf16 matmul on the MXU:
+    (deps × bits) @ (bits × refs) == popcount(bits of r);
+  * the spectral filter         -> a count-min sketch (saturating scatter-add,
+    min-of-k query).
+
+Everything is fixed-shape and jittable; rows are packed 32 bits/uint32 lane for
+storage (`bits/32` words) and unpacked to 0/1 planes only inside a stage, where
+elementwise min/max on {0,1} plays bitwise AND/OR.  A Pallas kernel can later run
+the packed AND directly; the planes layout is already the MXU-friendly one.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import hashing
+
+DEFAULT_BITS = 2048
+DEFAULT_HASHES = 4
+
+# Row budgets for host-side chunking of the build stages (see models/approximate).
+BUILD_ROW_BUDGET = 1 << 18
+
+
+def bit_positions(ids, *, bits: int, num_hashes: int):
+    """(n, k) int32 hash-bit positions in [0, bits) for dense int32 ids.
+
+    The double-hashing scheme (h1 + i*h2, as in Guava's BloomFilterStrategies):
+    two mixed 32-bit hashes generate all k positions.
+    """
+    h1 = hashing.hash_cols([ids], seed=1)
+    h2 = hashing.hash_cols([ids], seed=2) | jnp.uint32(1)  # odd => full period
+    i = jnp.arange(num_hashes, dtype=jnp.uint32)
+    pos = h1[:, None] + i[None, :] * h2[:, None]
+    return (pos & jnp.uint32(bits - 1)).astype(jnp.int32)
+
+
+def pack_planes(planes):
+    """(m, bits) 0/1 uint8 planes -> (m, bits//32) uint32 packed rows."""
+    m, bits = planes.shape
+    lanes = planes.reshape(m, bits // 32, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return (lanes * weights[None, None, :]).sum(axis=2, dtype=jnp.uint32)
+
+
+def unpack_planes(packed):
+    """(m, W) uint32 packed rows -> (m, 32*W) 0/1 uint8 planes."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (packed[:, :, None] >> shifts[None, None, :]) & jnp.uint32(1)
+    return bits.reshape(packed.shape[0], packed.shape[1] * 32).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("num_lines", "bits", "num_hashes"))
+def build_line_blooms(line_gid, cap_id, valid, *, num_lines: int, bits: int,
+                      num_hashes: int):
+    """Packed Bloom row per join line from (line, capture) membership rows.
+
+    line_gid: dense line id per row; cap_id: capture id per row.  Invalid rows are
+    dropped.  Returns (num_lines, bits//32) uint32.
+    """
+    pos = bit_positions(cap_id, bits=bits, num_hashes=num_hashes)
+    li = jnp.where(valid, line_gid, num_lines)[:, None]
+    planes = jnp.zeros((num_lines, bits), jnp.uint8)
+    planes = planes.at[li, pos].max(jnp.uint8(1), mode="drop")
+    return pack_planes(planes)
+
+
+@functools.partial(jax.jit, static_argnames=("num_caps", "bits"))
+def intersect_dep_sketches(cap_id, line_bloom_rows, valid, *, num_caps: int,
+                           bits: int):
+    """Per-dependent refset sketch: AND of the line Blooms the dependent occurs in.
+
+    cap_id: capture id per row; line_bloom_rows: (n_rows, W) packed Bloom of each
+    row's line.  Returns (num_caps, W) uint32; captures with no valid rows keep the
+    all-ones sketch (empty AND), which callers must mask by support anyway.
+    """
+    planes = unpack_planes(line_bloom_rows)
+    ci = jnp.where(valid, cap_id, num_caps)
+    acc = jnp.ones((num_caps, bits), jnp.uint8)
+    acc = acc.at[ci].min(planes, mode="drop")
+    return pack_planes(acc)
+
+
+@functools.partial(jax.jit, static_argnames=("bits", "num_hashes"))
+def contains_matrix(sketch_tile, ref_ids, ref_valid, *, bits: int,
+                    num_hashes: int):
+    """(deps_tile × refs_tile) membership test on the MXU.
+
+    sketch_tile: (D, W) packed dep sketches; ref_ids: (R,) capture ids.  Returns
+    bool (D, R): True where every hash bit of ref r is set in sketch d — the
+    candidate matrix of the approximate strategies.  The contraction runs as a
+    bf16 matmul with f32 accumulation (counts <= num_hashes, exactly
+    representable).
+    """
+    planes = unpack_planes(sketch_tile)  # (D, bits)
+    r = ref_ids.shape[0]
+    pos = bit_positions(ref_ids, bits=bits, num_hashes=num_hashes)  # (R, k)
+    ref_planes = jnp.zeros((r, bits), jnp.uint8)
+    ref_planes = ref_planes.at[jnp.arange(r)[:, None], pos].max(jnp.uint8(1))
+    popc = ref_planes.sum(axis=1, dtype=jnp.int32)  # <= k (hash collisions)
+    hits = jax.lax.dot_general(
+        planes.astype(jnp.bfloat16), ref_planes.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    return (hits.astype(jnp.int32) == popc[None, :]) & ref_valid[None, :]
+
+
+# ---------------------------------------------------------------------------
+# Spectral filter analog: count-min sketch (saturating counts, min-of-k query).
+# ---------------------------------------------------------------------------
+
+MAX_COUNT_MIN_CAP = (1 << 16) - 1
+_CM_CHUNK = 1 << 14
+
+
+@functools.partial(jax.jit, static_argnames=("bits", "num_hashes", "cap"))
+def count_min_add(keys, counts, valid, *, bits: int, num_hashes: int,
+                  cap: int = MAX_COUNT_MIN_CAP):
+    """Build a count-min sketch row: (bits,) int32 counters saturating at `cap`.
+
+    The reference's SpectralBloomFilter (MultiunionHalfApproximateOverlap
+    Candidates.scala:40-47) uses small fixed-width counters that saturate by
+    design; `cap` (<= 2^16-1) is that width.  Each counter ends at exactly
+    min(true_sum, cap): contributions are clipped to cap and accumulated in
+    chunks of 2^14 rows with a clamp between chunks, so partial sums stay
+    below 2^30 and int32 never wraps (x64 is disabled in this stack, so an
+    int64 accumulator would silently truncate).
+    """
+    if not 0 < cap <= MAX_COUNT_MIN_CAP:
+        raise ValueError(f"cap must be in (0, {MAX_COUNT_MIN_CAP}]")
+    pos = bit_positions(keys, bits=bits, num_hashes=num_hashes)
+    c = jnp.clip(jnp.where(valid, counts, 0), 0, cap).astype(jnp.int32)
+    n = keys.shape[0]
+    n_chunks = max(1, -(-n // _CM_CHUNK))
+    padded = n_chunks * _CM_CHUNK
+    pos = jnp.pad(pos, ((0, padded - n), (0, 0)))
+    c = jnp.pad(c, (0, padded - n))
+
+    def body(table, xs):
+        p, cc = xs
+        inc = jnp.zeros(bits, jnp.int32).at[p].add(cc[:, None])
+        return jnp.minimum(table + inc, cap), None
+
+    table, _ = jax.lax.scan(
+        body, jnp.zeros(bits, jnp.int32),
+        (pos.reshape(n_chunks, _CM_CHUNK, -1), c.reshape(n_chunks, _CM_CHUNK)))
+    return table
+
+
+@functools.partial(jax.jit, static_argnames=("bits", "num_hashes"))
+def count_min_query(table, keys, *, bits: int, num_hashes: int):
+    """Upper bound on each key's count: min over its k counters (getCount analog)."""
+    pos = bit_positions(keys, bits=bits, num_hashes=num_hashes)
+    return table[pos].min(axis=1)
+
+
+def merge_count_min(tables, cap: int = MAX_COUNT_MIN_CAP):
+    """Sum of count-min tables (the combiner-tree merge), saturating."""
+    acc = np.zeros_like(np.asarray(tables[0]), dtype=np.int64)
+    for t in tables:
+        acc += np.asarray(t, np.int64)
+    return np.minimum(acc, cap).astype(np.int32)

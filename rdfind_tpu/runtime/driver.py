@@ -19,7 +19,7 @@ from .. import oracle
 from ..data import CindTable
 from ..dictionary import Dictionary, intern_triples
 from ..io import ntriples, prefixes, reader
-from ..models import allatonce, sharded, small_to_large
+from ..models import allatonce, approximate, late_bb, sharded, small_to_large
 from ..parallel.mesh import make_mesh
 
 
@@ -223,19 +223,11 @@ def _report(cfg: Config, counters: dict, timings: dict) -> None:
         print(f"csv:{csv}", file=sys.stderr)
 
 
-def _not_implemented_strategy(name, fallback):
-    def f(*args, **kwargs):
-        print(f"note: traversal strategy {name} not yet implemented natively; "
-              f"using all-at-once (identical output)", file=sys.stderr)
-        return fallback(*args, **kwargs)
-    return f
-
-
 # Strategy ids follow the reference (RDFind.scala:50-56): 0 = all-at-once,
 # 1 = small-to-large (default), 2 = approximate all-at-once, 3 = late-BB.
 STRATEGIES = {
     0: allatonce.discover,
     1: small_to_large.discover,
-    2: _not_implemented_strategy("approximate-all-at-once", allatonce.discover),
-    3: _not_implemented_strategy("late-bb", allatonce.discover),
+    2: approximate.discover,
+    3: late_bb.discover,
 }

@@ -1,0 +1,105 @@
+"""ApproximateAllAtOnce (strategy id 2): raw-output equivalence with AllAtOnce.
+
+The sketch round may only add verification work (false positives), never change
+the result — raw and clean_implied outputs must match allatonce.discover exactly,
+across random datasets, tiny sketches (high FPP), supports, and flag combinations.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from rdfind_tpu.dictionary import intern_triples
+from rdfind_tpu.models import allatonce, approximate
+
+from test_allatonce import oracle_rows, random_triples
+
+
+def run_approx(triples, min_support, **kw):
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    return approximate.discover(ids, min_support, **kw)
+
+
+def run_exact(triples, min_support, **kw):
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    return allatonce.discover(ids, min_support, **kw)
+
+
+def rows(table):
+    return set(table.to_rows())
+
+
+@pytest.mark.parametrize("seed,min_support", [(0, 1), (1, 2), (2, 3), (3, 2)])
+def test_matches_allatonce_raw(seed, min_support):
+    rng = random.Random(seed)
+    triples = random_triples(rng, 120, 12, 4, 8)
+    got = rows(run_approx(triples, min_support))
+    want = rows(run_exact(triples, min_support))
+    assert got == want
+
+
+def test_matches_oracle_clean_implied():
+    rng = random.Random(7)
+    triples = random_triples(rng, 100, 10, 3, 6)
+    ids, dct = intern_triples(np.asarray(triples, dtype=object))
+    table = approximate.discover(ids, 2, clean_implied=True)
+    got = set()
+    for c in table.decoded(dct):
+        got.add((c.dep_code, c.dep_v1, c.dep_v2 if c.dep_v2 is not None else -1,
+                 c.ref_code, c.ref_v1, c.ref_v2 if c.ref_v2 is not None else -1,
+                 c.support))
+    import rdfind_tpu.oracle as oracle
+    want = {(c[0], c[1], -1 if c[2] == oracle.NO_VALUE else c[2],
+             c[3], c[4], -1 if c[5] == oracle.NO_VALUE else c[5], c[6])
+            for c in oracle.minimize_cinds(
+                oracle.discover_cinds_definitional(triples, 2))}
+    assert got == want
+
+
+def test_tiny_sketch_still_exact():
+    # 64 bits for hundreds of captures => massive FPP; only cost, not correctness.
+    rng = random.Random(11)
+    triples = random_triples(rng, 150, 15, 4, 10)
+    got = rows(run_approx(triples, 2, sketch_bits=64, sketch_hashes=2))
+    want = rows(run_exact(triples, 2))
+    assert got == want
+
+
+def test_chunked_sketch_build_matches():
+    # Force multi-chunk sketch building (row budget smaller than the data).
+    rng = random.Random(13)
+    triples = random_triples(rng, 200, 8, 3, 6)
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    st = {}
+    got = rows(approximate.discover(ids, 2))
+    want = rows(allatonce.discover(ids, 2))
+    assert got == want
+    # Direct comparison of sketch matrices: one chunk vs many.
+    state = approximate.prepare_join_lines(ids, 2, "spo", True, False, st)
+    a = approximate._build_sketches(state["line_val_h"], state["line_cap_h"],
+                                    state["num_caps"], bits=256, num_hashes=3)
+    b = approximate._build_sketches(state["line_val_h"], state["line_cap_h"],
+                                    state["num_caps"], bits=256, num_hashes=3,
+                                    row_budget=64)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_association_rules_and_fc_flags():
+    rng = random.Random(17)
+    triples = random_triples(rng, 90, 9, 3, 6)
+    for kw in (dict(use_association_rules=True),
+               dict(use_frequent_condition_filter=False),
+               dict(use_association_rules=True, clean_implied=True)):
+        got = rows(run_approx(triples, 2, **kw))
+        want = rows(run_exact(triples, 2, **kw))
+        assert got == want, kw
+
+
+def test_empty_and_degenerate():
+    assert len(run_approx([], 2)) == 0
+    assert len(approximate.discover(np.zeros((0, 3), np.int32), 1)) == 0
+    one = [("a", "b", "c")]
+    got = rows(run_approx(one, 1))
+    want = rows(run_exact(one, 1))
+    assert got == want

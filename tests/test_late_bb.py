@@ -1,0 +1,101 @@
+"""LateBB (strategy id 3): raw semantics + clean-implied equivalence with AllAtOnce."""
+
+import random
+
+import numpy as np
+import pytest
+
+from rdfind_tpu import conditions as cc
+from rdfind_tpu import oracle
+from rdfind_tpu.data import NO_VALUE
+from rdfind_tpu.dictionary import intern_triples
+from rdfind_tpu.models import allatonce, late_bb
+
+from test_allatonce import random_triples
+
+
+def run_latebb(triples, min_support, **kw):
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    return set(late_bb.discover(ids, min_support, **kw).to_rows())
+
+
+def run_exact(triples, min_support, **kw):
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    return set(allatonce.discover(ids, min_support, **kw).to_rows())
+
+
+def latebb_raw_from_allatonce(raw_rows):
+    """Expected raw LateBB = raw AllAtOnce minus 2/x CINDs implied by a 1/x CIND
+    via a value-substituted dep subcapture."""
+    cind_pairs = {(r[0:3], r[3:6]) for r in raw_rows}
+
+    def subcaptures(cap):
+        code, v1, v2 = cap
+        return ((int(cc.first_subcapture(code)), v1, NO_VALUE),
+                (int(cc.second_subcapture(code)), v2, NO_VALUE))
+
+    out = set()
+    for r in raw_rows:
+        dep, ref = r[0:3], r[3:6]
+        if cc.is_binary(dep[0]) and any(
+                (sub, ref) in cind_pairs for sub in subcaptures(dep)):
+            continue
+        out.add(r)
+    return out
+
+
+@pytest.mark.parametrize("seed,min_support", [(0, 1), (1, 2), (2, 3), (5, 2)])
+def test_raw_semantics(seed, min_support):
+    rng = random.Random(seed)
+    triples = random_triples(rng, 120, 12, 4, 8)
+    got = run_latebb(triples, min_support)
+    want = latebb_raw_from_allatonce(run_exact(triples, min_support))
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_clean_implied_equals_allatonce(seed):
+    rng = random.Random(seed)
+    triples = random_triples(rng, 100, 10, 3, 6)
+    got = run_latebb(triples, 2, clean_implied=True)
+    want = run_exact(triples, 2, clean_implied=True)
+    assert got == want
+
+
+def test_round1_is_exactly_unary_dep_cinds():
+    rng = random.Random(9)
+    triples = random_triples(rng, 110, 10, 3, 7)
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    stats = {}
+    rows = set(late_bb.discover(ids, 2, stats=stats).to_rows())
+    unary_dep = {r for r in rows if cc.is_unary(r[0])}
+    exact = {r for r in set(allatonce.discover(ids, 2).to_rows())
+             if cc.is_unary(r[0])}
+    assert unary_dep == exact
+    assert stats["n_round1_cinds"] == len(exact)
+
+
+def test_tiny_sketch_still_correct():
+    rng = random.Random(21)
+    triples = random_triples(rng, 120, 10, 3, 8)
+    got = run_latebb(triples, 2, sketch_bits=64, sketch_hashes=2)
+    want = latebb_raw_from_allatonce(run_exact(triples, 2))
+    assert got == want
+
+
+def test_with_flags():
+    rng = random.Random(23)
+    triples = random_triples(rng, 90, 9, 3, 6)
+    for kw in (dict(use_association_rules=True),
+               dict(use_frequent_condition_filter=False),
+               dict(use_association_rules=True, clean_implied=True)):
+        got = run_latebb(triples, 2, **kw)
+        if kw.get("clean_implied"):
+            want = run_exact(triples, 2, **kw)
+        else:
+            want = latebb_raw_from_allatonce(run_exact(triples, 2, **kw))
+        assert got == want, kw
+
+
+def test_empty():
+    assert len(late_bb.discover(np.zeros((0, 3), np.int32), 1)) == 0

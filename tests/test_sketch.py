@@ -1,0 +1,133 @@
+"""Bitset-sketch ops: packing round trips, Bloom conservativeness, count-min bounds."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from rdfind_tpu.ops import sketch
+
+BITS = 256
+K = 3
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    planes = rng.integers(0, 2, size=(7, BITS), dtype=np.uint8)
+    packed = sketch.pack_planes(jnp.asarray(planes))
+    assert packed.shape == (7, BITS // 32)
+    back = np.asarray(sketch.unpack_planes(packed))
+    np.testing.assert_array_equal(back, planes)
+
+
+def test_bit_positions_deterministic_and_in_range():
+    ids = jnp.arange(100, dtype=jnp.int32)
+    p1 = np.asarray(sketch.bit_positions(ids, bits=BITS, num_hashes=K))
+    p2 = np.asarray(sketch.bit_positions(ids, bits=BITS, num_hashes=K))
+    np.testing.assert_array_equal(p1, p2)
+    assert p1.shape == (100, K)
+    assert p1.min() >= 0 and p1.max() < BITS
+    # Distinct ids should rarely share all positions.
+    flat = {tuple(row) for row in p1}
+    assert len(flat) > 90
+
+
+def _reference_sketches(rows, num_lines, num_caps):
+    """Dict-of-sets oracle: per-dep exact refsets from (line, cap) rows."""
+    lines = {}
+    for line, cap in rows:
+        lines.setdefault(line, set()).add(cap)
+    refsets = {}
+    for caps in lines.values():
+        for d in caps:
+            if d in refsets:
+                refsets[d] &= caps
+            else:
+                refsets[d] = set(caps)
+    return refsets
+
+
+def test_bloom_sketch_is_conservative():
+    rng = np.random.default_rng(1)
+    n_rows, num_lines, num_caps = 400, 40, 30
+    line = rng.integers(0, num_lines, n_rows).astype(np.int32)
+    cap = rng.integers(0, num_caps, n_rows).astype(np.int32)
+    rows = np.unique(np.stack([line, cap], 1), axis=0)
+    line, cap = rows[:, 0], rows[:, 1]
+    valid = jnp.ones(len(line), bool)
+
+    blooms = sketch.build_line_blooms(
+        jnp.asarray(line), jnp.asarray(cap), valid,
+        num_lines=num_lines, bits=BITS, num_hashes=K)
+    sketches = sketch.intersect_dep_sketches(
+        jnp.asarray(cap), blooms[jnp.asarray(line)], valid,
+        num_caps=num_caps, bits=BITS)
+
+    ref_ids = jnp.arange(num_caps, dtype=jnp.int32)
+    cand = np.asarray(sketch.contains_matrix(
+        sketches, ref_ids, jnp.ones(num_caps, bool), bits=BITS, num_hashes=K))
+
+    refsets = _reference_sketches(rows.tolist(), num_lines, num_caps)
+    for d, refs in refsets.items():
+        for r in refs:
+            assert cand[d, r], f"true ref {r} of dep {d} missing from candidates"
+
+
+def test_bloom_sketch_prunes_something():
+    # Two disjoint cliques of lines: caps of clique A must not list clique-B-only
+    # caps as candidates (with overwhelming probability at 256 bits / 10 caps).
+    rows = [(l, c) for l in range(5) for c in range(5)] + \
+           [(5 + l, 5 + c) for l in range(5) for c in range(5)]
+    rows = np.asarray(rows, np.int32)
+    valid = jnp.ones(len(rows), bool)
+    blooms = sketch.build_line_blooms(
+        jnp.asarray(rows[:, 0]), jnp.asarray(rows[:, 1]), valid,
+        num_lines=10, bits=BITS, num_hashes=K)
+    sketches = sketch.intersect_dep_sketches(
+        jnp.asarray(rows[:, 1]), blooms[jnp.asarray(rows[:, 0])], valid,
+        num_caps=10, bits=BITS)
+    cand = np.asarray(sketch.contains_matrix(
+        sketches, jnp.arange(10, dtype=jnp.int32), jnp.ones(10, bool),
+        bits=BITS, num_hashes=K))
+    assert cand[:5, :5].all() and cand[5:, 5:].all()
+    assert not cand[:5, 5:].any() and not cand[5:, :5].any()
+
+
+def test_count_min_upper_bound_and_merge():
+    rng = np.random.default_rng(2)
+    keys = rng.integers(0, 50, 300).astype(np.int32)
+    counts = rng.integers(1, 5, 300).astype(np.int32)
+    true = {}
+    for k, c in zip(keys, counts):
+        true[int(k)] = true.get(int(k), 0) + int(c)
+
+    halves = []
+    for sl in (slice(0, 150), slice(150, 300)):
+        halves.append(sketch.count_min_add(
+            jnp.asarray(keys[sl]), jnp.asarray(counts[sl]),
+            jnp.ones(150, bool), bits=BITS, num_hashes=K))
+    merged = sketch.merge_count_min(halves)
+    q = np.asarray(sketch.count_min_query(
+        jnp.asarray(merged), jnp.asarray(np.arange(50, dtype=np.int32)),
+        bits=BITS, num_hashes=K))
+    for k in range(50):
+        assert q[k] >= true.get(k, 0)
+    # At 256 counters for 50 keys the bound should usually be tight.
+    exact = sum(int(q[k]) == true.get(k, 0) for k in range(50))
+    assert exact >= 40
+
+
+def test_count_min_saturation():
+    t = sketch.count_min_add(
+        jnp.zeros(4, jnp.int32), jnp.full(4, 100, jnp.int32),
+        jnp.ones(4, bool), bits=64, num_hashes=2, cap=150)
+    assert int(np.asarray(t).max()) == 150
+
+
+def test_invalid_rows_ignored():
+    line = jnp.asarray([0, 0, 1], jnp.int32)
+    cap = jnp.asarray([0, 1, 2], jnp.int32)
+    valid = jnp.asarray([True, True, False])
+    blooms = sketch.build_line_blooms(line, cap, valid, num_lines=2, bits=64,
+                                      num_hashes=2)
+    # Line 1's bloom must be empty: its only row is invalid.
+    assert int(np.asarray(blooms)[1].sum()) == 0
