@@ -38,8 +38,6 @@ from ..data import CindTable
 from ..ops import frequency, segments, sketch
 from . import allatonce, small_to_large
 
-SENTINEL = segments.SENTINEL
-
 DEP_TILE = 1 << 12
 
 
@@ -99,17 +97,25 @@ def _candidate_pairs(sketches, num_caps, *, bits, num_hashes,
     Tiled over dependents; each tile is one MXU containment matmul.  Optional
     dep_mask/ref_mask restrict either side (used by the LateBB rounds).
     """
-    ref_ids = jnp.arange(num_caps, dtype=jnp.int32)
-    ref_ok = jnp.asarray(ref_mask if ref_mask is not None
-                         else np.ones(num_caps, bool))
+    # Pad both sides to bucketed capacities so contains_matrix compiles once per
+    # (tile, ref_cap) bucket instead of once per dataset (pow2 capacity policy).
+    ref_cap = segments.pow2_capacity(num_caps)
+    ref_ids = jnp.arange(ref_cap, dtype=jnp.int32)
+    ref_ok_h = np.zeros(ref_cap, bool)
+    ref_ok_h[:num_caps] = True if ref_mask is None else ref_mask[:num_caps]
+    ref_ok = jnp.asarray(ref_ok_h)
     out_d, out_r = [], []
     for lo in range(0, num_caps, dep_tile):
         hi = min(lo + dep_tile, num_caps)
         if dep_mask is not None and not dep_mask[lo:hi].any():
             continue
-        tile = jnp.asarray(sketches[lo:hi])
+        tile_h = sketches[lo:hi]
+        if tile_h.shape[0] < dep_tile:
+            tile_h = np.concatenate([tile_h, np.zeros(
+                (dep_tile - tile_h.shape[0], tile_h.shape[1]), tile_h.dtype)])
         cand = np.array(sketch.contains_matrix(
-            tile, ref_ids, ref_ok, bits=bits, num_hashes=num_hashes))
+            jnp.asarray(tile_h), ref_ids, ref_ok,
+            bits=bits, num_hashes=num_hashes))[:hi - lo, :num_caps]
         if dep_mask is not None:
             cand &= dep_mask[lo:hi, None]
         d, r = np.nonzero(cand)

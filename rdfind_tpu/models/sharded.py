@@ -9,9 +9,23 @@ jax.sharding.Mesh with three bucket exchanges riding ICI/DCN:
     -> exchange A: route by hash(join value)         [all_to_all]
     -> join-line dedupe at the value owner           [device-local]
     -> exchange B: route (capture, 1) by hash(capture); owner counts support
+    -> skew split: oversized join lines -> all devices, sliced  [all_gather]
     -> pair emission + local pair counts             [device-local, quadratic part]
     -> exchange C: route pair partials by hash(dependent capture)
     -> merge counts, sorted-join against support, CIND test   [device-local]
+
+Skew engine (the reference's join-line rebalancing, SURVEY.md §5 "long-context
+analog"): a join line shared by m captures costs m(m-1) pairs, so one hot value can
+swamp its owner device.  Like the reference — which annotates sizes
+(AnnotateJoinLineSizes.scala:19-41), computes the global average quadratic load
+(RDFind.scala:421-424), replicates oversized lines (AssignJoinLineRebalancing
+.scala:48-64) and lets each replica process a hash-slice of dependent captures
+(CreateDependencyCandidates.scala:136-154) — lines whose load exceeds
+max(avg*factor, floor) are pulled out of the local pair path, all_gather'ed (XLA
+lowers this to a ring of ICI ppermutes), and every device emits pairs only for the
+dependents it owns by hash, i.e. ~1/D of each giant line's rows against the full
+line.  An absolute backstop (load > cap_pairs/4) also splits when the whole
+distribution is heavy, so the local pair budget never has to absorb one huge line.
 
 Captures travel as raw (code, v1, v2) key triples — no global capture interning is
 needed, because every grouping is a hash-bucketed sort on the owning device.
@@ -52,10 +66,19 @@ def _masked_counts(valid, inverse, num_segments):
     return jax.ops.segment_sum(w, ids, num_segments=num_segments)
 
 
+# Split lines whose quadratic load exceeds `rebalance_factor` times the global
+# average (the reference's default-ish aggressiveness), but never bother below
+# _MIN_SPLIT_LOAD pairs — replication overhead would beat the win.
+REBALANCE_FACTOR = 8.0
+_MIN_SPLIT_LOAD = 256
+
+
 def _device_step(triples, n_valid, min_support, *, projections,
-                 cap_exchange_a, cap_exchange_b, cap_pairs, cap_exchange_c):
+                 cap_exchange_a, cap_exchange_b, cap_pairs, cap_exchange_c,
+                 cap_giant, cap_giant_pairs):
     """One device's slice of the discovery step (runs inside shard_map)."""
     num_dev = jax.lax.psum(1, AXIS)
+    my_idx = jax.lax.axis_index(AXIS)
     t = triples.shape[0]
     valid_t = jnp.arange(t, dtype=jnp.int32) < n_valid[0]
 
@@ -80,13 +103,57 @@ def _device_step(triples, n_valid, min_support, *, projections,
     tbl_cols, tbl_valid, tbl_inv, n_caps = segments.masked_unique(ccols, cvalid)
     tbl_counts = _masked_counts(cvalid, tbl_inv, tbl_cols[0].shape[0])
 
-    # --- Pair emission (quadratic hot path) + local partial counts.
-    pos, length, start_idx, total_pairs = pairs.line_layout(jv, n_rows)
-    ovf_p = jax.lax.psum(jnp.maximum(total_pairs - cap_pairs, 0), AXIS)
-    row, partner, pvalid = pairs.emit_pair_indices(pos, length, start_idx, cap_pairs)
-    pair_cols = [code[row], v1[row], v2[row], code[partner], v1[partner], v2[partner]]
-    pcols, pvalid2, pinv, _ = segments.masked_unique(pair_cols, pvalid)
-    pcnt = _masked_counts(pvalid, pinv, pcols[0].shape[0])
+    # --- Skew stats: per-line quadratic load + global average (f32: loads overflow
+    # int32 long before they overflow the threshold math's precision needs).
+    pos, length, start_idx, _ = pairs.line_layout(jv, n_rows)
+    is_start = valid & (pos == 0)
+    len_f = length.astype(jnp.float32)
+    load_f = len_f * (len_f - 1.0)
+    total_load = jax.lax.psum(jnp.where(is_start, load_f, 0.0).sum(), AXIS)
+    total_lines = jax.lax.psum(is_start.sum(), AXIS)
+    avg_load = total_load / jnp.maximum(total_lines, 1).astype(jnp.float32)
+    thresh = jnp.minimum(
+        jnp.maximum(avg_load * REBALANCE_FACTOR, jnp.float32(_MIN_SPLIT_LOAD)),
+        jnp.float32(cap_pairs // 4))  # absolute backstop
+    is_giant = valid & (load_f > thresh)
+    n_giant_lines = jax.lax.psum((is_start & is_giant).sum(), AXIS)
+
+    # --- Pair emission for normal lines (giant rows get length 1 => no pairs).
+    length_n = jnp.where(is_giant, 1, length)
+    total_norm = pairs.saturating_cumsum(jnp.where(valid, length_n - 1, 0))[-1]
+    ovf_p = jax.lax.psum(jnp.maximum(total_norm - cap_pairs, 0), AXIS)
+    row, partner, pvalid = pairs.emit_pair_indices(pos, length_n, start_idx,
+                                                   cap_pairs)
+    # --- Giant lines: extract whole lines, all_gather, process an owned dep slice.
+    g_cols, n_g = segments.compact([jv, code, v1, v2], is_giant)
+    ovf_g = jax.lax.psum(jnp.maximum(n_g - cap_giant, 0), AXIS)
+    g_valid = jnp.arange(cap_giant, dtype=jnp.int32) < n_g
+    gg = [jax.lax.all_gather(c[:cap_giant], AXIS, tiled=True) for c in g_cols]
+    gg_valid = jax.lax.all_gather(g_valid, AXIS, tiled=True)
+    # Regroup gathered rows by line (jv is globally unique per line, so sorting by
+    # it alone re-forms whole lines; in-line order is irrelevant to rotations).
+    permg = segments.lexsort([jnp.where(gg_valid, gg[0], SENTINEL)])
+    jv_g, code_g, v1_g, v2_g = (c[permg] for c in gg)
+    gv = gg_valid[permg]
+    posg, leng, startg, _ = pairs.line_layout(jv_g, gv.sum())
+    own = gv & (hashing.bucket_of([code_g, v1_g, v2_g], num_dev, seed=5) == my_idx)
+    (posd, lend, startd, dc, dv1, dv2), n_own = segments.compact(
+        [posg, leng, startg, code_g, v1_g, v2_g], own)
+    lend = jnp.where(jnp.arange(lend.shape[0], dtype=jnp.int32) < n_own, lend, 1)
+    total_g = pairs.saturating_cumsum(lend - 1)[-1]
+    ovf_gp = jax.lax.psum(jnp.maximum(total_g - cap_giant_pairs, 0), AXIS)
+    growp, gpart, gpvalid = pairs.emit_pair_indices(posd, lend, startd,
+                                                    cap_giant_pairs)
+    n_giant_pairs = jax.lax.psum(total_g, AXIS)
+
+    # --- Local partial counts over the combined (normal + giant-slice) stream.
+    pair_cols = [jnp.concatenate([a[row], b[growp]])
+                 for a, b in ((code, dc), (v1, dv1), (v2, dv2))]
+    pair_cols += [jnp.concatenate([a[partner], b[gpart]])
+                  for a, b in ((code, code_g), (v1, v1_g), (v2, v2_g))]
+    pvalid_all = jnp.concatenate([pvalid, gpvalid])
+    pcols, pvalid2, pinv, _ = segments.masked_unique(pair_cols, pvalid_all)
+    pcnt = _masked_counts(pvalid_all, pinv, pcols[0].shape[0])
 
     # --- Exchange C: co-locate pair partials with the dependent capture's owner.
     pair_bucket = hashing.bucket_of(pcols[0:3], num_dev, seed=2)
@@ -111,20 +178,27 @@ def _device_step(triples, n_valid, min_support, *, projections,
     keep = is_cind & ~implied
 
     out_cols, n_out = segments.compact(list(ucols) + [dep_count], keep)
-    overflow = ovf_a + ovf_b + ovf_p + ovf_c
-    return (*out_cols, jnp.full(1, n_out, jnp.int32), jnp.full(1, overflow, jnp.int32))
+    # Per-site overflow counts (already psum'd => replicated): callers grow only
+    # the capacities that actually overflowed.
+    overflow = jnp.stack([ovf_a, ovf_b, ovf_p, ovf_c, ovf_g, ovf_gp])
+    return (*out_cols, jnp.full(1, n_out, jnp.int32), overflow,
+            jnp.full(1, n_giant_lines, jnp.int32),
+            jnp.full(1, n_giant_pairs, jnp.int32))
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "projections", "cap_exchange_a", "cap_exchange_b",
-                     "cap_pairs", "cap_exchange_c"))
+                     "cap_pairs", "cap_exchange_c", "cap_giant",
+                     "cap_giant_pairs"))
 def _sharded_step(triples, n_valid, min_support, *, mesh, projections,
-                  cap_exchange_a, cap_exchange_b, cap_pairs, cap_exchange_c):
+                  cap_exchange_a, cap_exchange_b, cap_pairs, cap_exchange_c,
+                  cap_giant, cap_giant_pairs):
     fn = functools.partial(
         _device_step, projections=projections, cap_exchange_a=cap_exchange_a,
         cap_exchange_b=cap_exchange_b, cap_pairs=cap_pairs,
-        cap_exchange_c=cap_exchange_c)
+        cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
+        cap_giant_pairs=cap_giant_pairs)
     return jax.shard_map(
         fn, mesh=mesh,
         in_specs=(P(AXIS, None), P(AXIS), P()),
@@ -135,10 +209,11 @@ def _sharded_step(triples, n_valid, min_support, *, mesh, projections,
 
 def discover_sharded(triples, min_support: int, mesh=None, projections: str = "spo",
                      clean_implied: bool = False,
-                     max_retries: int = 3) -> CindTable:
+                     max_retries: int = 3, stats: dict | None = None) -> CindTable:
     """Discover all CINDs with the full step sharded over `mesh` (default: all devices).
 
-    Output is identical to models.allatonce.discover.
+    Output is identical to models.allatonce.discover.  If `stats` is a dict it
+    receives skew-engine counters (n_giant_lines, n_giant_pairs).
     """
     if mesh is None:
         mesh = make_mesh()
@@ -167,19 +242,41 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
     cap_b = segments.pow2_capacity(num_dev * cap_a)
     cap_p = segments.pow2_capacity(4 * num_dev * cap_a)
     cap_c = cap_p
+    cap_g = segments.pow2_capacity(max(256, cap_a // 8))
+    # Each device owns ~1/D of every giant line's dependents, so the per-device
+    # giant-pair budget can sit below the normal budget (capped at 1/4 — the
+    # overflow-retry loop is the safety net for heavier-than-expected skew).
+    # Keeping it small matters: the combined pair stream (cap_p + cap_gp rows)
+    # is what the hot-path dedup sort runs over.
+    cap_gp = max(cap_p // min(num_dev, 4), 1 << 10)
 
+    site_names = ("exchange_a", "exchange_b", "pairs", "exchange_c",
+                  "giant_rows", "giant_pairs")
     for attempt in range(max_retries):
         out = _sharded_step(
             jnp.asarray(padded), jnp.asarray(n_valid), jnp.int32(min_support),
             mesh=mesh, projections=projections, cap_exchange_a=cap_a,
-            cap_exchange_b=cap_b, cap_pairs=cap_p, cap_exchange_c=cap_c)
-        *cols, n_out, overflow = out
-        if int(np.max(np.asarray(overflow))) == 0:
+            cap_exchange_b=cap_b, cap_pairs=cap_p, cap_exchange_c=cap_c,
+            cap_giant=cap_g, cap_giant_pairs=cap_gp)
+        *cols, n_out, overflow, n_giant_lines, n_giant_pairs = out
+        # (num_dev, 6), identical rows (psum'd inside the step).
+        ovf = np.asarray(overflow).reshape(num_dev, 6)[0]
+        if int(ovf.sum()) == 0:
             break
-        cap_a, cap_b, cap_p, cap_c = (2 * cap_a, 2 * cap_b, 2 * cap_p, 2 * cap_c)
+        # Grow only what overflowed, past the deficit in one step.
+        caps = [cap_a, cap_b, cap_p, cap_c, cap_g, cap_gp]
+        for i in range(6):
+            if ovf[i] > 0:
+                caps[i] = segments.pow2_capacity(2 * caps[i] + int(ovf[i]))
+        cap_a, cap_b, cap_p, cap_c, cap_g, cap_gp = caps
     else:
+        detail = ", ".join(f"{n}={int(v)}" for n, v in zip(site_names, ovf) if v)
         raise RuntimeError(
-            f"bucket-exchange overflow persisted after {max_retries} retries")
+            f"bucket-exchange overflow persisted after {max_retries} retries "
+            f"({detail})")
+    if stats is not None:
+        stats["n_giant_lines"] = int(np.asarray(n_giant_lines)[0])
+        stats["n_giant_pairs"] = int(np.asarray(n_giant_pairs)[0])
 
     # Collect per-device outputs: cols are (num_dev * block,) arrays.
     cols = [np.asarray(c) for c in cols]

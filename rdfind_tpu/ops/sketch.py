@@ -44,7 +44,13 @@ def bit_positions(ids, *, bits: int, num_hashes: int):
 
     The double-hashing scheme (h1 + i*h2, as in Guava's BloomFilterStrategies):
     two mixed 32-bit hashes generate all k positions.
+
+    `bits` must be a power of two >= 32: positions are masked with `bits - 1`
+    (a non-pow2 width would silently dead-zone part of the filter) and rows are
+    packed 32 bits per uint32 lane.
     """
+    if bits < 32 or bits & (bits - 1):
+        raise ValueError(f"sketch bits must be a power of two >= 32, got {bits}")
     h1 = hashing.hash_cols([ids], seed=1)
     h2 = hashing.hash_cols([ids], seed=2) | jnp.uint32(1)  # odd => full period
     i = jnp.arange(num_hashes, dtype=jnp.uint32)

@@ -13,7 +13,7 @@ import pytest
 from rdfind_tpu.dictionary import intern_triples
 from rdfind_tpu.models import allatonce, approximate
 
-from test_allatonce import oracle_rows, random_triples
+from test_allatonce import random_triples
 
 
 def run_approx(triples, min_support, **kw):

@@ -65,3 +65,37 @@ def test_sharded_projections(mesh8):
 def test_sharded_empty(mesh8):
     out = sharded.discover_sharded(np.zeros((0, 3), np.int32), 2, mesh=mesh8)
     assert len(out) == 0
+
+
+def skewed_triples(rng, n_hot, n_cold):
+    """One scorching join value (o0 shared by n_hot distinct (s,p) combos) plus a
+    cold tail — the power-law shape the skew engine exists for."""
+    rows = [(f"s{i}", f"p{i % 5}", "o0") for i in range(n_hot)]
+    rows += [(f"s{rng.randrange(40)}", f"p{rng.randrange(5)}",
+              f"o{1 + rng.randrange(30)}") for _ in range(n_cold)]
+    rng.shuffle(rows)
+    return rows
+
+
+@pytest.mark.parametrize("min_support", [1, 3])
+def test_skew_split_matches_single_chip(mesh8, min_support):
+    rng = random.Random(11)
+    ids, _ = intern_triples(
+        np.asarray(skewed_triples(rng, 120, 200), dtype=object))
+    stats = {}
+    a = sharded.discover_sharded(ids, min_support, mesh=mesh8, stats=stats)
+    b = allatonce.discover(ids, min_support)
+    assert a.to_rows() == b.to_rows()
+    # The hot line must actually have been routed through the split path.
+    assert stats["n_giant_lines"] >= 1
+    assert stats["n_giant_pairs"] > 0
+
+
+def test_skew_split_device_invariance(mesh8):
+    rng = random.Random(12)
+    ids, _ = intern_triples(
+        np.asarray(skewed_triples(rng, 80, 120), dtype=object))
+    want = allatonce.discover(ids, 2).to_rows()
+    for d in (1, 4, 8):
+        got = sharded.discover_sharded(ids, 2, mesh=make_mesh(d)).to_rows()
+        assert got == want, f"mismatch on {d}-device mesh"
