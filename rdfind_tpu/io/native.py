@@ -1,0 +1,129 @@
+"""ctypes bindings for the native ingest runtime (native/rdfind_native.cpp).
+
+The native path fuses read + gz decompression + tokenization + interning into
+one C++ pass and hands back the (N, 3) int32 id table directly — the hot ingest
+path for large dumps.  The pure-Python path (io/reader.py + io/ntriples.py +
+dictionary.intern_triples) remains the reference implementation and the
+fallback when the shared library is absent and cannot be built.
+
+Semantics: identical ids/values for valid-UTF-8 inputs (byte-sort order ==
+np.unique's code-point order).  For invalid UTF-8 the native path is strictly
+more exact: it interns raw bytes (distinct byte strings stay distinct), while
+the Python reader's errors="replace" can conflate them; exported values are
+decoded with errors="replace" either way.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+from ..dictionary import Dictionary
+
+_SO_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_rdfind_native.so")
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+
+_lib = None
+_lib_error: str | None = None
+
+
+class NativeIngestError(RuntimeError):
+    pass
+
+
+def _build() -> bool:
+    """Best-effort build of the shared library via the checked-in Makefile."""
+    if not os.path.isdir(_NATIVE_DIR):
+        return False
+    try:
+        proc = subprocess.run(["make", "-C", _NATIVE_DIR],
+                              capture_output=True, text=True, timeout=120)
+        return proc.returncode == 0 and os.path.exists(_SO_PATH)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _bind(lib):
+    lib.rdf_ingest_new.restype = ctypes.c_void_p
+    lib.rdf_ingest_free.argtypes = [ctypes.c_void_p]
+    lib.rdf_ingest_error.argtypes = [ctypes.c_void_p]
+    lib.rdf_ingest_error.restype = ctypes.c_char_p
+    lib.rdf_ingest_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.rdf_ingest_file.restype = ctypes.c_int64
+    lib.rdf_ingest_finalize.argtypes = [ctypes.c_void_p]
+    lib.rdf_ingest_finalize.restype = ctypes.c_int64
+    lib.rdf_ingest_num_triples.argtypes = [ctypes.c_void_p]
+    lib.rdf_ingest_num_triples.restype = ctypes.c_int64
+    lib.rdf_ingest_get_triples.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.rdf_ingest_values_bytes.argtypes = [ctypes.c_void_p]
+    lib.rdf_ingest_values_bytes.restype = ctypes.c_int64
+    lib.rdf_ingest_get_values.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_void_p]
+    return lib
+
+
+def load():
+    """The bound library, building it on first use; None if unavailable."""
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    if os.environ.get("RDFIND_NATIVE", "").lower() in ("0", "false", "no"):
+        _lib_error = "disabled via RDFIND_NATIVE"
+        return None
+    if not os.path.exists(_SO_PATH) and not _build():
+        _lib_error = "shared library missing and build failed"
+        return None
+    try:
+        _lib = _bind(ctypes.CDLL(_SO_PATH))
+    except OSError as e:
+        _lib_error = str(e)
+        return None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def ingest_files(paths, tabs: bool = False, expect_quad: bool = False,
+                 skip_comments: bool = True):
+    """Parse + intern all files natively.  Returns ((N, 3) int32 ids, Dictionary).
+
+    Raises NativeIngestError on parse errors (same failure surface as the
+    Python parser's ParseError) or if the library is unavailable.
+    """
+    lib = load()
+    if lib is None:
+        raise NativeIngestError(f"native ingest unavailable: {_lib_error}")
+    h = lib.rdf_ingest_new()
+    try:
+        for p in paths:
+            rc = lib.rdf_ingest_file(h, os.fspath(p).encode(), int(tabs),
+                                     int(expect_quad), int(skip_comments))
+            if rc < 0:
+                raise NativeIngestError(
+                    lib.rdf_ingest_error(h).decode(errors="replace"))
+        n_values = lib.rdf_ingest_finalize(h)
+        n_triples = lib.rdf_ingest_num_triples(h)
+        ids = np.empty((n_triples, 3), np.int32)
+        if n_triples:
+            lib.rdf_ingest_get_triples(h, ids.ctypes.data_as(ctypes.c_void_p))
+        nbytes = lib.rdf_ingest_values_bytes(h)
+        buf = np.empty(nbytes, np.uint8)
+        offsets = np.empty(n_values + 1, np.int64)
+        lib.rdf_ingest_get_values(
+            h, buf.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.c_void_p))
+    finally:
+        lib.rdf_ingest_free(h)
+    raw = buf.tobytes()
+    values = np.empty(n_values, object)
+    for i in range(n_values):
+        values[i] = raw[offsets[i]:offsets[i + 1]].decode(errors="replace")
+    return ids, Dictionary(values)

@@ -104,6 +104,10 @@ def _candidate_pairs(sketches, num_caps, *, bits, num_hashes,
     ref_ok_h = np.zeros(ref_cap, bool)
     ref_ok_h[:num_caps] = True if ref_mask is None else ref_mask[:num_caps]
     ref_ok = jnp.asarray(ref_ok_h)
+    # Pack the shared ref side once; every dep tile reuses it (pallas backend).
+    ref_pack = (sketch.pack_ref_bits(ref_ids, bits=bits, num_hashes=num_hashes)
+                if sketch._pallas_backend_default() == "pallas"
+                and bits % 128 == 0 else None)
     out_d, out_r = [], []
     for lo in range(0, num_caps, dep_tile):
         hi = min(lo + dep_tile, num_caps)
@@ -114,8 +118,8 @@ def _candidate_pairs(sketches, num_caps, *, bits, num_hashes,
             tile_h = np.concatenate([tile_h, np.zeros(
                 (dep_tile - tile_h.shape[0], tile_h.shape[1]), tile_h.dtype)])
         cand = np.array(sketch.contains_matrix(
-            jnp.asarray(tile_h), ref_ids, ref_ok,
-            bits=bits, num_hashes=num_hashes))[:hi - lo, :num_caps]
+            jnp.asarray(tile_h), ref_ids, ref_ok, bits=bits,
+            num_hashes=num_hashes, ref_pack=ref_pack))[:hi - lo, :num_caps]
         if dep_mask is not None:
             cand &= dep_mask[lo:hi, None]
         d, r = np.nonzero(cand)

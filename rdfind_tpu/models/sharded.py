@@ -125,6 +125,10 @@ def _device_step(triples, n_valid, min_support, *, projections,
     row, partner, pvalid = pairs.emit_pair_indices(pos, length_n, start_idx,
                                                    cap_pairs)
     # --- Giant lines: extract whole lines, all_gather, process an owned dep slice.
+    # Giant rows are a subset of the line rows, so the giant buffer never needs
+    # to exceed the row buffer (also guards slicing below: c[:cap] must not
+    # clamp shorter than g_valid's arange).
+    cap_giant = min(cap_giant, jv.shape[0])
     g_cols, n_g = segments.compact([jv, code, v1, v2], is_giant)
     ovf_g = jax.lax.psum(jnp.maximum(n_g - cap_giant, 0), AXIS)
     g_valid = jnp.arange(cap_giant, dtype=jnp.int32) < n_g

@@ -1,0 +1,103 @@
+"""Pallas TPU kernels for the packed-bitset hot path.
+
+The reference's compute-critical "native" surface is its forked-Guava Bloom
+filter (SURVEY.md §2: exportBits/intersect + SpectralBloomFilter) — the bit
+twiddling under every approximate strategy.  Here that surface is the packed
+(rows × bits/32) uint32 sketch matrix (ops/sketch.py), and its hot op is the
+containment matmul: "which hash-bit sets are fully contained in which sketch
+rows", for all (dep, ref) pairs at once.
+
+The jnp path (sketch.contains_matrix) unpacks both sides to full 0/1 planes in
+HBM — a 32x write + read amplification of pure memory traffic — before the MXU
+contraction.  The kernel below never materializes planes: each grid step DMAs a
+packed (TILE, W) uint32 tile into VMEM, unpacks 4 words (128 bits) at a time
+into bf16 registers, and feeds the MXU with (TILE, 128) @ (128, TILE) partial
+contractions, accumulating in f32.  HBM traffic drops to the packed bytes.
+
+Layout notes (see /opt/skills/guides/pallas_guide.md): last dim is 128 lanes;
+the unpack builds each 128-lane group by broadcasting one packed word column
+(TILE, 1) against a (1, 32) shift iota — no in-kernel reshapes or gathers, only
+broadcasts and lane-dim concatenation, which Mosaic handles natively.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_D = 128
+TILE_R = 128
+_WORDS_PER_STEP = 4  # 4 uint32 words = 128 contraction lanes = one full MXU K
+
+
+def _unpack4(ref, w0):
+    """(TILE, 4 words) of a packed uint32 ref -> (TILE, 128) 0/1 bf16 planes."""
+    shifts = jax.lax.broadcasted_iota(jnp.uint32, (1, 32), 1)
+    groups = [
+        ((ref[:, pl.ds(w0 + i, 1)] >> shifts) & jnp.uint32(1)).astype(jnp.bfloat16)
+        for i in range(_WORDS_PER_STEP)
+    ]
+    return jnp.concatenate(groups, axis=1)
+
+
+def _contains_kernel(s_ref, r_ref, popc_ref, out_ref):
+    """One (TILE_D, TILE_R) tile of the containment matrix.
+
+    s_ref: (TILE_D, W) packed dep sketches; r_ref: (TILE_R, W) packed ref bit
+    sets; popc_ref: (1, TILE_R) per-ref set bit counts.  out[d, r] = 1 iff every
+    set bit of ref r is set in sketch d, tested as <unpacked s, unpacked r> ==
+    popcount(r) — the same MXU formulation as the jnp path, minus the HBM planes.
+    """
+    w = s_ref.shape[1]
+
+    def body(k, acc):
+        s_b = _unpack4(s_ref, k * _WORDS_PER_STEP)
+        r_b = _unpack4(r_ref, k * _WORDS_PER_STEP)
+        return acc + jax.lax.dot_general(
+            s_b, r_b, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    acc = jax.lax.fori_loop(
+        0, w // _WORDS_PER_STEP, body,
+        jnp.zeros((s_ref.shape[0], r_ref.shape[0]), jnp.float32))
+    out_ref[:] = (acc.astype(jnp.int32) == popc_ref[:]).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
+                           interpret: bool = False):
+    """(D, R) uint8 containment matrix from packed uint32 rows.
+
+    sketch_packed: (D, W) packed dep sketches; ref_packed: (R, W) packed ref bit
+    sets; ref_popc: (R,) int32 popcounts of each ref row.  D and R must be
+    multiples of the 128-lane tile; W a multiple of 4.  `interpret=True` runs
+    the kernel in the Pallas interpreter (CPU tests).
+    """
+    d, w = sketch_packed.shape
+    r = ref_packed.shape[0]
+    if d % TILE_D or r % TILE_R or w % _WORDS_PER_STEP:
+        raise ValueError(f"shapes must be tile-aligned, got D={d} R={r} W={w}")
+    grid = (d // TILE_D, r // TILE_R)
+    return pl.pallas_call(
+        _contains_kernel,
+        out_shape=jax.ShapeDtypeStruct((d, r), jnp.uint8),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((TILE_D, w), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((TILE_R, w), lambda i, j: (j, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, TILE_R), lambda i, j: (0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((TILE_D, TILE_R), lambda i, j: (i, j),
+                                   memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(sketch_packed, ref_packed, ref_popc.reshape(1, r))

@@ -105,8 +105,32 @@ def intersect_dep_sketches(cap_id, line_bloom_rows, valid, *, num_caps: int,
 
 
 @functools.partial(jax.jit, static_argnames=("bits", "num_hashes"))
+def pack_ref_bits(ref_ids, *, bits: int, num_hashes: int):
+    """Packed (R, bits//32) uint32 bit sets of each ref id's k hash positions,
+    plus (R,) int32 popcounts — the ref-side operand of the packed kernel."""
+    r = ref_ids.shape[0]
+    pos = bit_positions(ref_ids, bits=bits, num_hashes=num_hashes)  # (R, k)
+    word, bit = pos >> 5, (pos & 31).astype(jnp.uint32)
+    rows = jnp.zeros((r, bits // 32), jnp.uint32)
+    ar = jnp.arange(r)
+    for i in range(pos.shape[1]):  # k is tiny; sequential read-OR-write per hash
+        prev = rows[ar, word[:, i]]
+        rows = rows.at[ar, word[:, i]].set(prev | (jnp.uint32(1) << bit[:, i]))
+    popc = jax.lax.population_count(rows).sum(axis=1).astype(jnp.int32)
+    return rows, popc
+
+
+def _pallas_backend_default() -> str:
+    import os
+    env = os.environ.get("RDFIND_PALLAS")
+    if env is not None:
+        return "jnp" if env.lower() in ("0", "false", "no") else "pallas"
+    return "pallas" if jax.default_backend() == "tpu" else "jnp"
+
+
 def contains_matrix(sketch_tile, ref_ids, ref_valid, *, bits: int,
-                    num_hashes: int):
+                    num_hashes: int, backend: str | None = None,
+                    interpret: bool = False, ref_pack=None):
     """(deps_tile × refs_tile) membership test on the MXU.
 
     sketch_tile: (D, W) packed dep sketches; ref_ids: (R,) capture ids.  Returns
@@ -114,7 +138,42 @@ def contains_matrix(sketch_tile, ref_ids, ref_valid, *, bits: int,
     candidate matrix of the approximate strategies.  The contraction runs as a
     bf16 matmul with f32 accumulation (counts <= num_hashes, exactly
     representable).
+
+    backend: "pallas" (packed fused kernel, default on TPU — see
+    ops/pallas_kernels.py) or "jnp" (unpacked-planes formulation, default
+    elsewhere); `interpret` runs the Pallas kernel in interpreter mode (CPU
+    tests).  `ref_pack` optionally supplies a precomputed pack_ref_bits result
+    so callers looping over dep tiles pack the shared ref side once.
     """
+    if backend is None:
+        backend = _pallas_backend_default()
+    if backend == "pallas" and bits % 128 == 0:
+        from . import pallas_kernels
+
+        d = sketch_tile.shape[0]
+        r = ref_ids.shape[0]
+        dp = -d % pallas_kernels.TILE_D
+        rp = -r % pallas_kernels.TILE_R
+        ref_packed, popc = (ref_pack if ref_pack is not None else
+                            pack_ref_bits(ref_ids, bits=bits,
+                                          num_hashes=num_hashes))
+        if dp:
+            sketch_tile = jnp.pad(sketch_tile, ((0, dp), (0, 0)))
+        if rp:
+            ref_packed = jnp.pad(ref_packed, ((0, rp), (0, 0)))
+            # Padded refs get popc 0 while their row is empty => hits==popc
+            # would hold; pin popc to an unreachable value instead.
+            popc = jnp.pad(popc, (0, rp), constant_values=jnp.int32(-1))
+        out = pallas_kernels.packed_contains_matrix(
+            sketch_tile, ref_packed, popc, interpret=interpret)
+        return (out[:d, :r] == 1) & ref_valid[None, :]
+    return _contains_matrix_jnp(sketch_tile, ref_ids, ref_valid, bits=bits,
+                                num_hashes=num_hashes)
+
+
+@functools.partial(jax.jit, static_argnames=("bits", "num_hashes"))
+def _contains_matrix_jnp(sketch_tile, ref_ids, ref_valid, *, bits: int,
+                         num_hashes: int):
     planes = unpack_planes(sketch_tile)  # (D, bits)
     r = ref_ids.shape[0]
     pos = bit_positions(ref_ids, bits=bits, num_hashes=num_hashes)  # (R, k)

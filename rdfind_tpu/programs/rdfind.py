@@ -66,6 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rebalance-threshold", type=float, default=1.0,
                    help=argparse.SUPPRESS)
     p.add_argument("--hash-function", default="MD5", help=argparse.SUPPRESS)
+    p.add_argument("--no-native-ingest", action="store_true",
+                   help="force the pure-Python ingest path")
     return p
 
 
@@ -101,6 +103,7 @@ def main(argv=None) -> int:
         debug_level=args.debug_level,
         counter_level=args.counter_level,
         n_devices=args.dop,
+        native_ingest=not args.no_native_ingest,
     )
     result = driver.run(cfg)
     if not (cfg.output_file or cfg.collect_result):

@@ -18,7 +18,7 @@ import numpy as np
 from .. import oracle
 from ..data import CindTable
 from ..dictionary import Dictionary, intern_triples
-from ..io import ntriples, prefixes, reader
+from ..io import native, ntriples, prefixes, reader
 from ..models import allatonce, approximate, late_bb, sharded, small_to_large
 from ..parallel.mesh import make_mesh
 
@@ -49,6 +49,7 @@ class Config:
     debug_level: int = 0
     counter_level: int = 0
     n_devices: int = 1  # degree of parallelism (the reference's -dop)
+    native_ingest: bool = True  # C++ fused read+parse+intern when applicable
 
 
 @dataclasses.dataclass
@@ -74,10 +75,16 @@ class _Phases:
         return out
 
 
-def load_triples(cfg: Config, phases: _Phases, counters: dict):
-    """Host ingest: files -> list of (s, p, o) string tokens."""
+def _resolve_inputs(cfg: Config):
+    """Input paths + quad-format sniff (shared by the native and Python paths)."""
     paths = reader.resolve_path_patterns(cfg.input_paths)
     is_nq = paths[0].endswith((".nq", ".nq.gz"))
+    return paths, is_nq
+
+
+def load_triples(cfg: Config, phases: _Phases, counters: dict):
+    """Host ingest: files -> list of (s, p, o) string tokens."""
+    paths, is_nq = _resolve_inputs(cfg)
 
     def parse_all():
         out = []
@@ -117,15 +124,27 @@ def run(cfg: Config) -> RunResult:
     phases = _Phases()
     counters: dict = {}
 
-    raw = load_triples(cfg, phases, counters)
-    if cfg.only_read:
-        _report(cfg, counters, phases.timings)
-        return RunResult(CindTable.empty(), None, None, counters, phases.timings)
-
-    ids, dictionary = phases.run(
-        "intern", lambda: intern_triples(np.asarray(raw, dtype=object)))
+    # Native fused ingest (read+parse+intern in one C++ pass) whenever the
+    # string-level preprocessing options that need raw tokens are off.
+    use_native = (cfg.native_ingest and native.available()
+                  and not cfg.asciify_triples and not cfg.prefix_paths
+                  and not cfg.only_read)
+    if use_native:
+        paths, is_nq = _resolve_inputs(cfg)
+        ids, dictionary = phases.run("read+parse", lambda: native.ingest_files(
+            paths, tabs=cfg.tabs, expect_quad=is_nq))
+        counters["input-triples"] = ids.shape[0]
+        phases.timings["intern"] = 0.0  # folded into the native pass
+    else:
+        raw = load_triples(cfg, phases, counters)
+        if cfg.only_read:
+            _report(cfg, counters, phases.timings)
+            return RunResult(CindTable.empty(), None, None, counters,
+                             phases.timings)
+        ids, dictionary = phases.run(
+            "intern", lambda: intern_triples(np.asarray(raw, dtype=object)))
+        del raw
     counters["distinct-values"] = len(dictionary)
-    del raw
 
     if cfg.distinct_triples:
         ids = phases.run("distinct", lambda: np.unique(ids, axis=0))
@@ -153,7 +172,7 @@ def run(cfg: Config) -> RunResult:
             mesh = make_mesh(cfg.n_devices)
             return sharded.discover_sharded(
                 ids, cfg.min_support, mesh=mesh, projections=cfg.projections,
-                clean_implied=cfg.clean_implied)
+                clean_implied=cfg.clean_implied, stats=stats)
         # Strategy dispatch (TraversalStrategy registry, RDFind.scala:50-56).
         strategy = STRATEGIES.get(cfg.traversal_strategy)
         if strategy is None:

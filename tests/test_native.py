@@ -1,0 +1,115 @@
+"""Native C++ ingest vs. the pure-Python reference path, on identical inputs."""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from rdfind_tpu.dictionary import intern_triples
+from rdfind_tpu.io import native, ntriples, reader
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+NT = """\
+# a comment line
+<http://ex/s1> <http://ex/p1> "plain literal" .
+<http://ex/s1> <http://ex/p2> "esc \\" quote"@en .
+<http://ex/s2> <http://ex/p1> "typed"^^<http://www.w3.org/2001/XMLSchema#string> .
+_:blank1 <http://ex/p3> <http://ex/s1> .
+
+<http://ex/s3> <http://ex/p1> "tab\\tin literal" .
+"""
+
+NQ = """\
+<http://ex/s1> <http://ex/p1> <http://ex/o1> <http://ex/g1> .
+<http://ex/s2> <http://ex/p1> "lit" <http://ex/g2> .
+"""
+
+
+def python_path(paths, tabs=False, expect_quad=False):
+    rows = []
+    for _, line in reader.iter_lines(paths):
+        t = (ntriples.parse_tab_line(line) if tabs
+             else ntriples.parse_line(line, expect_quad=expect_quad))
+        if t is not None:
+            rows.append(t)
+    return intern_triples(np.asarray(rows, dtype=object))
+
+
+def assert_same(got, want):
+    ids_n, d_n = got
+    ids_p, d_p = want
+    np.testing.assert_array_equal(ids_n, ids_p)
+    assert list(d_n.values) == list(d_p.values)
+
+
+def test_ntriples_parity(tmp_path):
+    f = tmp_path / "a.nt"
+    f.write_text(NT)
+    assert_same(native.ingest_files([str(f)]), python_path([str(f)]))
+
+
+def test_gz_and_multifile_parity(tmp_path):
+    f1 = tmp_path / "a.nt"
+    f1.write_text(NT)
+    f2 = tmp_path / "b.nt.gz"
+    with gzip.open(f2, "wt") as g:
+        g.write("<http://ex/sX> <http://ex/p1> \"from gz\" .\n")
+    paths = [str(f1), str(f2)]
+    assert_same(native.ingest_files(paths), python_path(paths))
+
+
+def test_nquads_parity(tmp_path):
+    f = tmp_path / "a.nq"
+    f.write_text(NQ)
+    assert_same(native.ingest_files([str(f)], expect_quad=True),
+                python_path([str(f)], expect_quad=True))
+
+
+def test_tabs_parity(tmp_path):
+    f = tmp_path / "a.tsv"
+    f.write_text("s1\tp1\to1\ns2\tp1\to2\n\ns1\tp2\to1\textra ignored\n")
+    assert_same(native.ingest_files([str(f)], tabs=True),
+                python_path([str(f)], tabs=True))
+
+
+def test_crlf_and_no_trailing_newline(tmp_path):
+    f = tmp_path / "a.nt"
+    f.write_bytes(b"<s> <p> <o1> .\r\n<s> <p> <o2> .")
+    assert_same(native.ingest_files([str(f)]), python_path([str(f)]))
+
+
+def test_parse_error_surface(tmp_path):
+    f = tmp_path / "bad.nt"
+    f.write_text("<http://ex/s1> <http://ex/p1>\n")
+    with pytest.raises(native.NativeIngestError, match="expected 3 terms"):
+        native.ingest_files([str(f)])
+    with pytest.raises(ntriples.ParseError):
+        python_path([str(f)])
+
+
+def test_unterminated_literal_error(tmp_path):
+    f = tmp_path / "bad.nt"
+    f.write_text('<s> <p> "never closed .\n')
+    with pytest.raises(native.NativeIngestError, match="unterminated literal"):
+        native.ingest_files([str(f)])
+
+
+def test_large_random_parity(tmp_path):
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(5000):
+        s = f"<http://ex/s{rng.integers(400)}>"
+        p = f"<http://ex/p{rng.integers(12)}>"
+        kind = rng.integers(3)
+        if kind == 0:
+            o = f"<http://ex/o{rng.integers(300)}>"
+        elif kind == 1:
+            o = f'"value {rng.integers(200)}"'
+        else:
+            o = f"_:b{rng.integers(50)}"
+        lines.append(f"{s} {p} {o} .")
+    f = tmp_path / "big.nt"
+    f.write_text("\n".join(lines) + "\n")
+    assert_same(native.ingest_files([str(f)]), python_path([str(f)]))

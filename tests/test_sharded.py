@@ -91,6 +91,18 @@ def test_skew_split_matches_single_chip(mesh8, min_support):
     assert stats["n_giant_pairs"] > 0
 
 
+def test_tiny_input_small_mesh():
+    # Regression: cap_giant larger than the whole row buffer must not break the
+    # gather slicing (4 triples on 1-/2-device meshes).
+    ids, _ = intern_triples(np.asarray(
+        [("s1", "p1", "o1"), ("s2", "p1", "o1"), ("s1", "p2", "o2"),
+         ("s2", "p2", "o2")], dtype=object))
+    want = allatonce.discover(ids, 1).to_rows()
+    for d in (1, 2):
+        got = sharded.discover_sharded(ids, 1, mesh=make_mesh(d)).to_rows()
+        assert got == want
+
+
 def test_skew_split_device_invariance(mesh8):
     rng = random.Random(12)
     ids, _ = intern_triples(
