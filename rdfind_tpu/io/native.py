@@ -124,6 +124,19 @@ def ingest_files(paths, tabs: bool = False, expect_quad: bool = False,
         lib.rdf_ingest_free(h)
     raw = buf.tobytes()
     values = np.empty(n_values, object)
+    try:
+        raw.decode("utf-8")
+        lossless = True
+    except UnicodeDecodeError:
+        lossless = False
     for i in range(n_values):
         values[i] = raw[offsets[i]:offsets[i + 1]].decode(errors="replace")
+    if not lossless and n_values:
+        # Invalid UTF-8: errors="replace" can reorder or even conflate values
+        # relative to the native byte-sort ranks, breaking Dictionary's
+        # sorted-unique invariant.  Re-canonicalize exactly like the Python
+        # path (np.unique on decoded strings) and remap the ids.
+        uniques, inverse = np.unique(values, return_inverse=True)
+        ids = inverse.astype(np.int32)[ids]
+        values = uniques
     return ids, Dictionary(values)

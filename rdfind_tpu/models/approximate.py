@@ -106,8 +106,7 @@ def _candidate_pairs(sketches, num_caps, *, bits, num_hashes,
     ref_ok = jnp.asarray(ref_ok_h)
     # Pack the shared ref side once; every dep tile reuses it (pallas backend).
     ref_pack = (sketch.pack_ref_bits(ref_ids, bits=bits, num_hashes=num_hashes)
-                if sketch._pallas_backend_default() == "pallas"
-                and bits % 128 == 0 else None)
+                if sketch.pallas_eligible(bits) else None)
     out_d, out_r = [], []
     for lo in range(0, num_caps, dep_tile):
         hi = min(lo + dep_tile, num_caps)

@@ -128,6 +128,14 @@ def _pallas_backend_default() -> str:
     return "pallas" if jax.default_backend() == "tpu" else "jnp"
 
 
+def pallas_eligible(bits: int, backend: str | None = None) -> bool:
+    """Whether contains_matrix will dispatch to the Pallas kernel — the single
+    eligibility rule, shared with callers that pre-pack the ref side."""
+    if backend is None:
+        backend = _pallas_backend_default()
+    return backend == "pallas" and bits % 128 == 0
+
+
 def contains_matrix(sketch_tile, ref_ids, ref_valid, *, bits: int,
                     num_hashes: int, backend: str | None = None,
                     interpret: bool = False, ref_pack=None):
@@ -145,9 +153,7 @@ def contains_matrix(sketch_tile, ref_ids, ref_valid, *, bits: int,
     tests).  `ref_pack` optionally supplies a precomputed pack_ref_bits result
     so callers looping over dep tiles pack the shared ref side once.
     """
-    if backend is None:
-        backend = _pallas_backend_default()
-    if backend == "pallas" and bits % 128 == 0:
+    if pallas_eligible(bits, backend):
         from . import pallas_kernels
 
         d = sketch_tile.shape[0]

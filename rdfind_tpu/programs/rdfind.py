@@ -68,6 +68,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hash-function", default="MD5", help=argparse.SUPPRESS)
     p.add_argument("--no-native-ingest", action="store_true",
                    help="force the pure-Python ingest path")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="directory for stage-boundary checkpoints; re-runs "
+                        "with unchanged inputs/flags resume from them")
     return p
 
 
@@ -104,6 +107,7 @@ def main(argv=None) -> int:
         counter_level=args.counter_level,
         n_devices=args.dop,
         native_ingest=not args.no_native_ingest,
+        checkpoint_dir=args.checkpoint_dir,
     )
     result = driver.run(cfg)
     if not (cfg.output_file or cfg.collect_result):

@@ -1,0 +1,104 @@
+"""Stage-boundary checkpointing of the driver's global artifacts.
+
+The reference has none (SURVEY.md §5: Flink 0.9 batch jobs are single-shot;
+partial results exist only as named sinks), but its expensive artifacts are few
+and small relative to the input — interned triple table + dictionary, final
+CIND table — so checkpointing them at phase boundaries is nearly free and makes
+re-runs over the same dump incremental.
+
+Each stage is one .npz written atomically (tmp + rename) and self-describing:
+it embeds the fingerprint of everything that influenced it (input file
+identities incl. size/mtime, and the config flags feeding that stage).  A load
+with a different fingerprint is a miss, never a wrong answer.  No pickle: the
+dictionary's strings are stored as one UTF-8 blob + offsets.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import zipfile
+
+import numpy as np
+
+from ..data import CindTable
+from ..dictionary import Dictionary
+
+
+def fingerprint(payload: dict) -> str:
+    """Stable digest of a JSON-serializable payload."""
+    blob = json.dumps(payload, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def input_signature(paths) -> list:
+    """Identity of the input files: path + size + mtime."""
+    out = []
+    for p in paths:
+        st = os.stat(p)
+        out.append([os.path.abspath(p), st.st_size, int(st.st_mtime_ns)])
+    return out
+
+
+class CheckpointStore:
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, stage: str) -> str:
+        return os.path.join(self.dir, f"{stage}.npz")
+
+    def save(self, stage: str, fp: str, arrays: dict) -> None:
+        tmp = self._path(stage) + ".tmp.npz"  # .npz suffix: savez won't rename
+        np.savez(tmp, __fingerprint__=np.frombuffer(fp.encode(), np.uint8),
+                 **arrays)
+        os.replace(tmp, self._path(stage))
+
+    def load(self, stage: str, fp: str) -> dict | None:
+        """The stage's arrays, or None if absent/stale/corrupt."""
+        path = self._path(stage)
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path) as z:
+                stored = bytes(z["__fingerprint__"]).decode()
+                if stored != fp:
+                    return None
+                return {k: z[k] for k in z.files if k != "__fingerprint__"}
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+            return None
+
+
+# --- Stage codecs -----------------------------------------------------------
+
+def encode_ingest(ids: np.ndarray, dictionary: Dictionary) -> dict:
+    values = [str(v).encode("utf-8") for v in dictionary.values]
+    offsets = np.zeros(len(values) + 1, np.int64)
+    np.cumsum([len(v) for v in values], out=offsets[1:])
+    return {
+        "ids": np.asarray(ids, np.int32),
+        "value_blob": np.frombuffer(b"".join(values), np.uint8),
+        "value_offsets": offsets,
+    }
+
+
+def decode_ingest(arrays: dict) -> tuple[np.ndarray, Dictionary]:
+    blob = arrays["value_blob"].tobytes()
+    offs = arrays["value_offsets"]
+    values = np.empty(len(offs) - 1, object)
+    for i in range(len(offs) - 1):
+        values[i] = blob[offs[i]:offs[i + 1]].decode("utf-8")
+    return arrays["ids"], Dictionary(values)
+
+
+_CIND_COLS = ("dep_code", "dep_v1", "dep_v2", "ref_code", "ref_v1", "ref_v2",
+              "support")
+
+
+def encode_cinds(table: CindTable) -> dict:
+    return {c: np.asarray(getattr(table, c), np.int64) for c in _CIND_COLS}
+
+
+def decode_cinds(arrays: dict) -> CindTable:
+    return CindTable(*(arrays[c] for c in _CIND_COLS))

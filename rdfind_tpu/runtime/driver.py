@@ -21,6 +21,7 @@ from ..dictionary import Dictionary, intern_triples
 from ..io import native, ntriples, prefixes, reader
 from ..models import allatonce, approximate, late_bb, sharded, small_to_large
 from ..parallel.mesh import make_mesh
+from . import checkpoint
 
 
 @dataclasses.dataclass
@@ -50,6 +51,7 @@ class Config:
     counter_level: int = 0
     n_devices: int = 1  # degree of parallelism (the reference's -dop)
     native_ingest: bool = True  # C++ fused read+parse+intern when applicable
+    checkpoint_dir: str | None = None  # stage-boundary checkpoints (resume)
 
 
 @dataclasses.dataclass
@@ -120,6 +122,29 @@ def load_triples(cfg: Config, phases: _Phases, counters: dict):
     return triples
 
 
+def _checkpoint_fps(cfg: Config, use_native: bool):
+    """(ingest_fp, discover_fp): digests of everything feeding each stage."""
+    paths, is_nq = _resolve_inputs(cfg)
+    ingest_payload = dict(
+        inputs=checkpoint.input_signature(paths), is_nq=is_nq, tabs=cfg.tabs,
+        asciify=cfg.asciify_triples,
+        prefixes=(checkpoint.input_signature(
+            reader.resolve_path_patterns(cfg.prefix_paths))
+            if cfg.prefix_paths else []),
+        distinct=cfg.distinct_triples,
+        # The two ingest implementations agree on valid UTF-8 but are allowed
+        # to differ on degenerate inputs; a checkpoint from one must not
+        # satisfy a run explicitly requesting the other.
+        native=use_native)
+    discover_payload = dict(
+        ingest=ingest_payload, min_support=cfg.min_support,
+        strategy=cfg.traversal_strategy, projections=cfg.projections,
+        use_fis=cfg.use_frequent_item_set, use_ars=cfg.use_association_rules,
+        clean_implied=cfg.clean_implied, n_devices=cfg.n_devices)
+    return checkpoint.fingerprint(ingest_payload), checkpoint.fingerprint(
+        discover_payload)
+
+
 def run(cfg: Config) -> RunResult:
     phases = _Phases()
     counters: dict = {}
@@ -129,26 +154,54 @@ def run(cfg: Config) -> RunResult:
     use_native = (cfg.native_ingest and native.available()
                   and not cfg.asciify_triples and not cfg.prefix_paths
                   and not cfg.only_read)
-    if use_native:
-        paths, is_nq = _resolve_inputs(cfg)
-        ids, dictionary = phases.run("read+parse", lambda: native.ingest_files(
-            paths, tabs=cfg.tabs, expect_quad=is_nq))
-        counters["input-triples"] = ids.shape[0]
-        phases.timings["intern"] = 0.0  # folded into the native pass
-    else:
-        raw = load_triples(cfg, phases, counters)
-        if cfg.only_read:
-            _report(cfg, counters, phases.timings)
-            return RunResult(CindTable.empty(), None, None, counters,
-                             phases.timings)
-        ids, dictionary = phases.run(
-            "intern", lambda: intern_triples(np.asarray(raw, dtype=object)))
-        del raw
-    counters["distinct-values"] = len(dictionary)
 
-    if cfg.distinct_triples:
-        ids = phases.run("distinct", lambda: np.unique(ids, axis=0))
-        counters["distinct-triples"] = ids.shape[0]
+    ckpt = ingest_fp = discover_fp = None
+    if cfg.checkpoint_dir and not cfg.only_read:
+        ckpt = checkpoint.CheckpointStore(cfg.checkpoint_dir)
+        ingest_fp, discover_fp = _checkpoint_fps(cfg, use_native)
+
+    ids = dictionary = None
+    if ckpt is not None:
+        stored = ckpt.load("ingest", ingest_fp)
+        if stored is not None:
+            ids, dictionary = phases.run(
+                "resume-ingest", lambda: checkpoint.decode_ingest(stored))
+            counters["input-triples"] = int(stored["input_triples"])
+            if "distinct_triples" in stored:
+                counters["distinct-triples"] = int(stored["distinct_triples"])
+            counters["resumed-ingest"] = 1
+
+    if ids is None:
+        if use_native:
+            paths, is_nq = _resolve_inputs(cfg)
+            ids, dictionary = phases.run(
+                "read+parse", lambda: native.ingest_files(
+                    paths, tabs=cfg.tabs, expect_quad=is_nq))
+            counters["input-triples"] = ids.shape[0]
+            phases.timings["intern"] = 0.0  # folded into the native pass
+        else:
+            raw = load_triples(cfg, phases, counters)
+            if cfg.only_read:
+                _report(cfg, counters, phases.timings)
+                return RunResult(CindTable.empty(), None, None, counters,
+                                 phases.timings)
+            ids, dictionary = phases.run(
+                "intern", lambda: intern_triples(np.asarray(raw, dtype=object)))
+            del raw
+        if cfg.distinct_triples:
+            ids = phases.run("distinct", lambda: np.unique(ids, axis=0))
+            counters["distinct-triples"] = ids.shape[0]
+        if ckpt is not None:
+            def save_ingest():
+                arrays = checkpoint.encode_ingest(ids, dictionary)
+                # Counter state rides along so resumed runs report identically.
+                arrays["input_triples"] = np.int64(counters["input-triples"])
+                if "distinct-triples" in counters:
+                    arrays["distinct_triples"] = np.int64(
+                        counters["distinct-triples"])
+                ckpt.save("ingest", ingest_fp, arrays)
+            phases.run("checkpoint-ingest", save_ingest)
+    counters["distinct-values"] = len(dictionary)
 
     if cfg.only_join:
         _report(cfg, counters, phases.timings)
@@ -183,7 +236,18 @@ def run(cfg: Config) -> RunResult:
             use_association_rules=use_ars,
             clean_implied=cfg.clean_implied, stats=stats)
 
-    table = phases.run("discover", discover)
+    table = None
+    if ckpt is not None:
+        stored = ckpt.load("discover", discover_fp)
+        if stored is not None:
+            table = phases.run("resume-discover",
+                               lambda: checkpoint.decode_cinds(stored))
+            counters["resumed-discover"] = 1
+    if table is None:
+        table = phases.run("discover", discover)
+        if ckpt is not None:
+            phases.run("checkpoint-discover", lambda: ckpt.save(
+                "discover", discover_fp, checkpoint.encode_cinds(table)))
     counters["cind-counter"] = len(table)
     counters.update({f"stat-{k}": v for k, v in stats.items()})
 

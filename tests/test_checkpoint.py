@@ -1,0 +1,94 @@
+"""Stage-boundary checkpoint/resume through the driver."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from rdfind_tpu.data import CindTable
+from rdfind_tpu.dictionary import Dictionary
+from rdfind_tpu.runtime import checkpoint, driver
+
+NT = """\
+<http://x/s1> <http://x/p1> "v1" .
+<http://x/s2> <http://x/p1> "v1" .
+<http://x/s1> <http://x/p2> "v1" .
+<http://x/s2> <http://x/p2> "v2" .
+<http://x/s3> <http://x/p2> "v2" .
+"""
+
+
+@pytest.fixture
+def fixture_nt(tmp_path):
+    f = tmp_path / "data.nt"
+    f.write_text(NT)
+    return str(f)
+
+
+def make_cfg(fixture_nt, tmp_path, **kw):
+    kw = {"min_support": 1, "traversal_strategy": 0, **kw}
+    return driver.Config(input_paths=[fixture_nt],
+                         checkpoint_dir=str(tmp_path / "ckpt"), **kw)
+
+
+def test_resume_roundtrip(fixture_nt, tmp_path):
+    cfg = make_cfg(fixture_nt, tmp_path)
+    first = driver.run(cfg)
+    assert "resumed-ingest" not in first.counters
+    assert os.path.exists(tmp_path / "ckpt" / "ingest.npz")
+    assert os.path.exists(tmp_path / "ckpt" / "discover.npz")
+
+    second = driver.run(cfg)
+    assert second.counters["resumed-ingest"] == 1
+    assert second.counters["resumed-discover"] == 1
+    assert second.table.to_rows() == first.table.to_rows()
+    assert list(second.dictionary.values) == list(first.dictionary.values)
+    np.testing.assert_array_equal(second.triples, first.triples)
+
+
+def test_flag_change_invalidates_discover_not_ingest(fixture_nt, tmp_path):
+    driver.run(make_cfg(fixture_nt, tmp_path))
+    res = driver.run(make_cfg(fixture_nt, tmp_path, min_support=2))
+    assert res.counters["resumed-ingest"] == 1
+    assert "resumed-discover" not in res.counters
+    # And the new discover result is checkpointed under its own fingerprint.
+    res2 = driver.run(make_cfg(fixture_nt, tmp_path, min_support=2))
+    assert res2.counters["resumed-discover"] == 1
+    assert res2.table.to_rows() == res.table.to_rows()
+
+
+def test_input_change_invalidates_everything(fixture_nt, tmp_path):
+    driver.run(make_cfg(fixture_nt, tmp_path))
+    time.sleep(0.01)
+    with open(fixture_nt, "a") as f:
+        f.write('<http://x/s4> <http://x/p1> "v9" .\n')
+    res = driver.run(make_cfg(fixture_nt, tmp_path))
+    assert "resumed-ingest" not in res.counters
+    assert "resumed-discover" not in res.counters
+    assert res.counters["input-triples"] == 6
+
+
+def test_corrupt_checkpoint_is_a_miss(fixture_nt, tmp_path):
+    cfg = make_cfg(fixture_nt, tmp_path)
+    first = driver.run(cfg)
+    with open(tmp_path / "ckpt" / "discover.npz", "wb") as f:
+        f.write(b"not an npz")
+    res = driver.run(cfg)
+    assert "resumed-discover" not in res.counters
+    assert res.table.to_rows() == first.table.to_rows()
+
+
+def test_ingest_codec_roundtrip():
+    ids = np.arange(12, dtype=np.int32).reshape(4, 3)
+    values = np.asarray(["", "a", "héllo", "züüü"], object)
+    out_ids, d = checkpoint.decode_ingest(
+        checkpoint.encode_ingest(ids, Dictionary(values)))
+    np.testing.assert_array_equal(out_ids, ids)
+    assert list(d.values) == list(values)
+
+
+def test_cind_codec_roundtrip():
+    t = CindTable(*(np.arange(i, i + 3, dtype=np.int64) for i in range(7)))
+    out = checkpoint.decode_cinds(checkpoint.encode_cinds(t))
+    assert out.to_rows() == t.to_rows()
