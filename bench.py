@@ -9,19 +9,80 @@ vs_baseline is measured in-process against the single-core pure-Python oracle
 (rdfind_tpu.oracle.discover_cinds_joinline) on a subsample, scaled to pairs/sec —
 the honest stand-in for the reference's single-worker throughput, since the repo
 ships no Flink cluster numbers (BASELINE.md: "published: none in repo").
+
+Resilience: the measurement machinery must always report, like the reference's
+AbstractFlinkProgram.java:65-77,175-182 (per-plan timing printed no matter what).
+Backend init is retried; on persistent TPU failure we fall back to local CPU and
+record the backend used; any unrecoverable error still prints a diagnostic JSON
+line (never a bare traceback) with value=0 so the driver can parse something.
 """
 
 import json
 import os
 import sys
 import time
+import traceback
 
 
-def main():
-    n = int(os.environ.get("BENCH_TRIPLES", 200_000))
-    min_support = int(os.environ.get("BENCH_MIN_SUPPORT", 10))
+def _probe_tpu_subprocess(timeout_s: int) -> tuple[bool, str]:
+    """Probe the default (TPU) backend in a subprocess with a hard timeout.
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    A hung tunnel blocks inside a C call, so no in-process watchdog (SIGALRM)
+    can interrupt it — only a killable subprocess gives a reliable verdict.
+    """
+    import subprocess
+
+    code = ("import jax, jax.numpy as jnp;"
+            "d = jax.devices();"
+            "jax.block_until_ready(jnp.zeros((8,), jnp.int32) + 1);"
+            "print(d[0].platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s}s"
+    if r.returncode == 0:
+        return True, r.stdout.strip().splitlines()[-1]
+    tail = (r.stderr or "").strip().splitlines()
+    return False, tail[-1] if tail else f"probe rc={r.returncode}"
+
+
+def _init_backend(retries: int = 2, delay_s: float = 5.0,
+                  attempt_timeout_s: int = 120) -> str:
+    """Initialize a usable jax backend, preferring the TPU; return its name.
+
+    The axon TPU tunnel can fail transiently ("Unable to initialize backend
+    'axon'", round-1 BENCH rc=1) or hang outright; probe it in a killable
+    subprocess, retry, then fall back to the local CPU backend so the bench
+    still produces a number (flagged via the backend field).  BENCH_BACKEND=cpu
+    pins CPU outright — note env JAX_PLATFORMS alone is NOT enough in this
+    image (sitecustomize force-sets the config), jax.config.update after
+    import is required.
+    """
+    import jax
+
+    forced = os.environ.get("BENCH_BACKEND")
+    if forced:
+        jax.config.update("jax_platforms", forced)
+        return jax.devices()[0].platform
+
+    last_err = None
+    for attempt in range(retries):
+        ok, info = _probe_tpu_subprocess(attempt_timeout_s)
+        if ok:
+            return jax.devices()[0].platform
+        last_err = info
+        time.sleep(delay_s * (attempt + 1))
+    # Persistent TPU failure: pin to CPU before any in-process jax op.
+    sys.stderr.write(f"bench: TPU backend unavailable after {retries} probes "
+                     f"({last_err}); falling back to cpu\n")
+    jax.config.update("jax_platforms", "cpu")
+    return jax.devices()[0].platform
+
+
+def _run(n: int, min_support: int) -> dict:
+    backend = _init_backend()
+
     from rdfind_tpu import oracle
     from rdfind_tpu.models import allatonce
     from rdfind_tpu.utils.synth import generate_triples
@@ -47,19 +108,50 @@ def main():
     allatonce.discover(sub, min_support, stats=sub_stats)
     oracle_pairs_per_sec = sub_stats["total_pairs"] / oracle_elapsed
 
-    print(json.dumps({
+    detail = {
+        "backend": backend,
+        "n_triples": n, "min_support": min_support,
+        "wall_s": round(elapsed, 3), "total_pairs": stats["total_pairs"],
+        "n_lines": stats["n_lines"], "max_line": stats["max_line"],
+        "cinds": len(table),
+        "oracle_pairs_per_sec": round(oracle_pairs_per_sec, 1),
+    }
+
+    # Pallas packed-bitset kernel vs jnp planes path, on this backend.
+    try:
+        from rdfind_tpu.ops import sketch
+        pk = sketch.kernel_selfcheck(n_rows=1024, n_bits=4096,
+                                     backend=backend)
+        detail["pallas_vs_jnp"] = pk
+    except Exception as e:  # kernel comparison is best-effort
+        detail["pallas_vs_jnp"] = {"error": f"{type(e).__name__}: {e}"}
+
+    return {
         "metric": "cind_pairs_checked_per_sec_per_chip",
         "value": round(pairs_per_sec, 1),
         "unit": "pairs/s",
         "vs_baseline": round(pairs_per_sec / oracle_pairs_per_sec, 3),
-        "detail": {
-            "n_triples": n, "min_support": min_support,
-            "wall_s": round(elapsed, 3), "total_pairs": stats["total_pairs"],
-            "n_lines": stats["n_lines"], "max_line": stats["max_line"],
-            "cinds": len(table),
-            "oracle_pairs_per_sec": round(oracle_pairs_per_sec, 1),
-        },
-    }))
+        "detail": detail,
+    }
+
+
+def main():
+    n = int(os.environ.get("BENCH_TRIPLES", 200_000))
+    min_support = int(os.environ.get("BENCH_MIN_SUPPORT", 10))
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        result = _run(n, min_support)
+    except Exception as e:
+        tb = traceback.format_exc(limit=3)
+        result = {
+            "metric": "cind_pairs_checked_per_sec_per_chip",
+            "value": 0,
+            "unit": "pairs/s",
+            "vs_baseline": 0,
+            "detail": {"error": f"{type(e).__name__}: {e}",
+                       "traceback": tb.splitlines()[-3:]},
+        }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

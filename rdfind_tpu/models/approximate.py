@@ -167,10 +167,14 @@ def discover(triples, min_support: int, projections: str = "spo",
     if stats is not None:
         stats["n_sketch_candidates"] = len(cand_dep)
 
+    def cooc_fn(dep_ok, ref_ok, stat_key):
+        return small_to_large._chunked_cooc(
+            st["line_val_h"], st["line_cap_h"], dep_ok, ref_ok,
+            pair_chunk_budget, stats, stat_key)
+
     d, r, sup = small_to_large._verify_level(
-        st["line_val_h"], st["line_cap_h"], cand_dep, cand_ref, st["num_caps"],
-        st["dep_count"], st["cap_code"], st["cap_v1"], st["cap_v2"],
-        min_support, pair_chunk_budget, stats, "pairs_verify")
+        cooc_fn, cand_dep, cand_ref, st["num_caps"], st["dep_count"],
+        st["cap_code"], st["cap_v1"], st["cap_v2"], min_support, "pairs_verify")
 
     cap_code, cap_v1, cap_v2 = st["cap_code"], st["cap_v1"], st["cap_v2"]
     table = CindTable(

@@ -65,11 +65,15 @@ def discover(triples, min_support: int, projections: str = "spo",
     dep_is_unary = unary[cand_dep]
 
     # Round 1: unary dependents, refs of both arities.
+    def cooc_fn(dep_ok, ref_ok, stat_key):
+        return small_to_large._chunked_cooc(
+            st["line_val_h"], st["line_cap_h"], dep_ok, ref_ok,
+            pair_chunk_budget, stats, stat_key)
+
     c1_dep, c1_ref = cand_dep[dep_is_unary], cand_ref[dep_is_unary]
     d1, r1, sup1 = small_to_large._verify_level(
-        st["line_val_h"], st["line_cap_h"], c1_dep, c1_ref, num_caps, dep_count,
-        cap_code, cap_v1, cap_v2, min_support, pair_chunk_budget, stats,
-        "pairs_round1")
+        cooc_fn, c1_dep, c1_ref, num_caps, dep_count,
+        cap_code, cap_v1, cap_v2, min_support, "pairs_round1")
     if stats is not None:
         stats.update(n_round1_candidates=len(c1_dep), n_round1_cinds=len(d1))
 
@@ -81,9 +85,8 @@ def discover(triples, min_support: int, projections: str = "spo",
                                           cap_code, cap_v1, cap_v2)
     c2_dep, c2_ref = c2_dep[keep], c2_ref[keep]
     d2, r2, sup2 = small_to_large._verify_level(
-        st["line_val_h"], st["line_cap_h"], c2_dep, c2_ref, num_caps, dep_count,
-        cap_code, cap_v1, cap_v2, min_support, pair_chunk_budget, stats,
-        "pairs_round2")
+        cooc_fn, c2_dep, c2_ref, num_caps, dep_count,
+        cap_code, cap_v1, cap_v2, min_support, "pairs_round2")
     if stats is not None:
         stats.update(n_round2_candidates=len(c2_dep), n_round2_cinds=len(d2))
 

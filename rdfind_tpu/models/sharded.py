@@ -1,22 +1,55 @@
-"""Multi-device AllAtOnce: the full discovery step sharded over a 1-D mesh.
+"""Multi-device discovery: AllAtOnce and SmallToLarge sharded over a 1-D mesh.
 
 The reference scales by hash-partitioning every operator over Flink task managers
-(SURVEY.md §2h); here the same dataflow runs as ONE jitted shard_map program over a
-jax.sharding.Mesh with three bucket exchanges riding ICI/DCN:
+(SURVEY.md §2h); here the same dataflow runs as jitted shard_map programs over a
+jax.sharding.Mesh with bucket exchanges riding ICI/DCN:
 
   triples (data-parallel shards)
-    -> emit join candidates, local dedupe            [device-local]
-    -> exchange A: route by hash(join value)         [all_to_all]
-    -> join-line dedupe at the value owner           [device-local]
+    -> [optional] distributed frequency filter     [count exchanges, see below]
+    -> emit join candidates, local dedupe          [device-local]
+    -> exchange A: route by hash(join value)       [all_to_all]
+    -> join-line dedupe at the value owner         [device-local]
     -> exchange B: route (capture, 1) by hash(capture); owner counts support
     -> skew split: oversized join lines -> all devices, sliced  [all_gather]
-    -> pair emission + local pair counts             [device-local, quadratic part]
+    -> pair emission + local pair counts           [device-local, quadratic part]
     -> exchange C: route pair partials by hash(dependent capture)
     -> merge counts, sorted-join against support, CIND test   [device-local]
 
+Stats-driven capacity planning (the reference's load-aware placement,
+LoadBasedPartitioner.scala:13-52 + AssignJoinLineRebalancing.scala:28-64 by
+*measured* load): before any exchange runs, a cheap planning program measures the
+actual per-(source, destination) bucket loads — distinct-key histograms for the
+count exchanges, the join-value histogram for exchange A — and the line-building
+program measures the capture-hash histogram (exchange B), the post-split pair
+totals, and the giant-row counts.  Capacities are set to the measured maxima plus
+headroom instead of the old "everything lands on one device" worst cases, so
+per-device buffers scale O(N/D + skew), not O(N).  Overflow is still psum-counted
+at every site and the host retries with grown capacities — planning is the fast
+path, retry is the safety net.
+
+Distributed frequency filter (the reference's broadcast Bloom-filter pruning,
+FrequentConditionPlanner.scala:201-283 + CreateJoinPartners.scala:48-76, exact
+here): per-row global condition counts come from exchange.global_row_counts —
+local distinct keys carry combiner-summed multiplicities to their hash owner and
+the sums ride the reply collective back to the asking rows.  Association-rule
+verdicts are then pure per-row comparisons (binary count == unary count), so AR
+suppression at emission needs no rule broadcast at all.
+
+Sharded SmallToLarge (the reference's *default* strategy, SmallToLargeTraversal
+Strategy.scala:38-171): the host drives the exact same lattice logic as the
+single-device strategy (small_to_large._run_lattice — candidate generation is
+host-side numpy over the small capture table, like the reference's driver-side
+plan construction), while each level's quadratic verification runs sharded: the
+level's (dep?, ref?) flags per capture are broadcast as a replicated flag table
+(the analog of the reference's broadcast candidate Bloom filters,
+SmallToLargeTraversalStrategy.scala:381-401), sorted-joined onto the
+device-resident join-line rows, and only flagged rows enter the skew-aware pair
+phase.  Join-line rows stay value-bucketed on device across all four levels —
+they are built once (exchange A/B) and never leave HBM.
+
 Skew engine (the reference's join-line rebalancing, SURVEY.md §5 "long-context
-analog"): a join line shared by m captures costs m(m-1) pairs, so one hot value can
-swamp its owner device.  Like the reference — which annotates sizes
+analog"): a join line shared by m captures costs m(m-1) pairs, so one hot value
+can swamp its owner device.  Like the reference — which annotates sizes
 (AnnotateJoinLineSizes.scala:19-41), computes the global average quadratic load
 (RDFind.scala:421-424), replicates oversized lines (AssignJoinLineRebalancing
 .scala:48-64) and lets each replica process a hash-slice of dependent captures
@@ -29,14 +62,6 @@ distribution is heavy, so the local pair budget never has to absorb one huge lin
 
 Captures travel as raw (code, v1, v2) key triples — no global capture interning is
 needed, because every grouping is a hash-bucketed sort on the owning device.
-
-Fixed capacities + overflow counters: every exchange and the pair buffer have static
-capacities; overflow is psum-counted and surfaced to the host, which retries with
-doubled capacities (the Flink analog — spill-to-disk — does not exist on TPU).
-
-The frequent-condition/-capture prefilters are not yet applied in this path (they
-are pure pruning, so output is unchanged); they land with the distributed frequency
-pass.
 """
 
 from __future__ import annotations
@@ -72,38 +97,222 @@ def _masked_counts(valid, inverse, num_segments):
 REBALANCE_FACTOR = 8.0
 _MIN_SPLIT_LOAD = 256
 
+# Hash seeds shared between the planning histograms and the real exchanges —
+# planning is only exact because both sides bucket identically.
+_SEED_VALUE = 1     # exchange A: join value
+_SEED_CAPTURE = 2   # exchange B + exchange C: capture key
+_SEED_GIANT = 5     # giant-line dependent ownership
+_SEED_UNARY = 11    # +f, f in 0..2: frequency count exchanges
+_SEED_BINARY = 17   # +k, k in 0..2
 
-def _device_step(triples, n_valid, min_support, *, projections,
-                 cap_exchange_a, cap_exchange_b, cap_pairs, cap_exchange_c,
-                 cap_giant, cap_giant_pairs):
-    """One device's slice of the discovery step (runs inside shard_map)."""
+
+def _freq_key_sets(triples):
+    """The 6 key sets of the frequency filter, with their exchange seeds."""
+    sets = [([triples[:, f]], _SEED_UNARY + f) for f in range(3)]
+    sets += [([triples[:, a], triples[:, b]], _SEED_BINARY + k)
+             for k, (a, b) in enumerate(frequency._FIELD_PAIRS)]
+    return sets
+
+
+def _distributed_frequency(triples, valid_t, min_support, cap_freq,
+                           find_ar_implied):
+    """frequency.triple_frequencies with GLOBAL counts (inside shard_map).
+
+    Six count exchanges (3 unary fields + 3 field pairs) against the keys' hash
+    owners; all verdicts are then local per-row comparisons.  Returns
+    (TripleFrequency, overflow): on overflow > 0 the verdicts are unusable and
+    the caller must retry with a larger cap_freq.
+    """
+    counts = []
+    ovf = jnp.int32(0)
+    for key_cols, seed in _freq_key_sets(triples):
+        c, o = exchange.global_row_counts(key_cols, valid_t, AXIS, cap_freq,
+                                          seed=seed)
+        counts.append(c)
+        ovf = ovf + o
+    unary_cnt, binary_cnt = counts[:3], counts[3:]
+    unary_ok = jnp.stack([c >= min_support for c in unary_cnt], axis=1)
+    binary_ok = jnp.stack([c >= min_support for c in binary_cnt], axis=1)
+    if find_ar_implied:
+        ar = jnp.stack([
+            (binary_cnt[k] == unary_cnt[a]) | (binary_cnt[k] == unary_cnt[b])
+            for k, (a, b) in enumerate(frequency._FIELD_PAIRS)
+        ], axis=1) & binary_ok
+    else:
+        ar = jnp.zeros_like(binary_ok)
+    return frequency.TripleFrequency(unary_ok=unary_ok, binary_ok=binary_ok,
+                                     binary_ar_implied=ar), ovf
+
+
+# ---------------------------------------------------------------------------
+# Capacity planning (P1): measure bucket loads before any exchange runs.
+# ---------------------------------------------------------------------------
+
+
+def _bucket_max(cols, valid, seed):
+    """Global max over (src, dst) of this device's valid-row count per bucket."""
     num_dev = jax.lax.psum(1, AXIS)
-    my_idx = jax.lax.axis_index(AXIS)
+    b = jnp.where(valid, hashing.bucket_of(cols, num_dev, seed=seed), num_dev)
+    hist = jax.ops.segment_sum(valid.astype(jnp.int32), b,
+                               num_segments=num_dev + 1)
+    return jax.lax.pmax(hist[:num_dev].max(), AXIS)
+
+
+def _plan_device(triples, n_valid, *, projections, use_fis):
+    """Measured capacity needs for the frequency exchanges and exchange A."""
     t = triples.shape[0]
     valid_t = jnp.arange(t, dtype=jnp.int32) < n_valid[0]
 
-    # --- Emission + local dedupe (combiner side of the join, cf. UnionJoinCandidates).
-    cands = emit_join_candidates(triples, frequency.no_filter(valid_t), projections)
+    cap_f = jnp.int32(0)
+    if use_fis:
+        for key_cols, seed in _freq_key_sets(triples):
+            u_cols, u_valid, _, _ = segments.masked_unique(key_cols, valid_t)
+            cap_f = jnp.maximum(cap_f, _bucket_max(u_cols, u_valid, seed))
+
+    # Exchange A load: unfiltered emission is an upper bound on the filtered one.
+    cands = emit_join_candidates(triples, frequency.no_filter(valid_t),
+                                 projections)
+    cols, valid, _, _ = segments.masked_unique(
+        [cands.join_val, cands.code, cands.v1, cands.v2], cands.valid)
+    cap_a = _bucket_max([cols[0]], valid, _SEED_VALUE)
+    return jnp.full(1, cap_f, jnp.int32), jnp.full(1, cap_a, jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "projections", "use_fis"))
+def _plan_step(triples, n_valid, *, mesh, projections, use_fis):
+    fn = functools.partial(_plan_device, projections=projections,
+                           use_fis=use_fis)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS)),
+                         out_specs=P(AXIS), check_vma=False)(triples, n_valid)
+
+
+# ---------------------------------------------------------------------------
+# Line building (P2): emission -> exchange A -> join-line dedupe + downstream
+# load measurement.
+# ---------------------------------------------------------------------------
+
+
+def _lines_device(triples, n_valid, min_support, *, projections, use_fis,
+                  use_ars, cap_freq, cap_exchange_a):
+    t = triples.shape[0]
+    valid_t = jnp.arange(t, dtype=jnp.int32) < n_valid[0]
+    num_dev = jax.lax.psum(1, AXIS)
+
+    if use_fis:
+        freq, ovf_f = _distributed_frequency(triples, valid_t, min_support,
+                                             cap_freq, use_ars)
+    else:
+        freq, ovf_f = frequency.no_filter(valid_t), jnp.int32(0)
+
+    # Emission + local dedupe (combiner side of the join, cf. UnionJoinCandidates).
+    cands = emit_join_candidates(triples, freq, projections)
     cols, valid, _, _ = segments.masked_unique(
         [cands.join_val, cands.code, cands.v1, cands.v2], cands.valid)
 
-    # --- Exchange A: co-locate equal join values.
-    bucket = hashing.bucket_of([cols[0]], num_dev, seed=1)
+    # Exchange A: co-locate equal join values.
+    bucket = hashing.bucket_of([cols[0]], num_dev, seed=_SEED_VALUE)
     cols, valid, ovf_a = exchange.bucket_exchange(cols, valid, bucket, AXIS,
                                                   cap_exchange_a)
 
-    # --- Join lines: distinct (value, capture), sorted by value at the owner.
+    # Join lines: distinct (value, capture), sorted by value at the owner.
     cols, valid, _, n_rows = segments.masked_unique(cols, valid)
     jv, code, v1, v2 = cols
 
-    # --- Exchange B: capture support counting at the capture owner.
-    cap_bucket = hashing.bucket_of([code, v1, v2], num_dev, seed=2)
+    # --- Downstream load measurement (the planning half of the skew engine).
+    cap_b = _bucket_max([code, v1, v2], valid, _SEED_CAPTURE)
+    pos, length, _, _ = pairs.line_layout(jv, n_rows)
+    is_start = valid & (pos == 0)
+    len_f = length.astype(jnp.float32)
+    load_f = len_f * (len_f - 1.0)
+    total_load = jax.lax.psum(jnp.where(is_start, load_f, 0.0).sum(), AXIS)
+    total_lines = jax.lax.psum(is_start.sum(), AXIS)
+    avg_load = total_load / jnp.maximum(total_lines, 1).astype(jnp.float32)
+    # No cap_pairs backstop here (it is what we are planning); the real pair
+    # phase may split a few more lines, which only lowers the normal budget.
+    thresh = jnp.maximum(avg_load * REBALANCE_FACTOR, jnp.float32(_MIN_SPLIT_LOAD))
+    is_giant = valid & (load_f > thresh)
+    norm_pairs = jnp.where(valid & ~is_giant, length - 1, 0)
+    cap_p = jax.lax.pmax(pairs.saturating_cumsum(norm_pairs)[-1], AXIS)
+    cap_g = jax.lax.pmax(is_giant.sum(), AXIS)
+    giant_load = jax.lax.psum(jnp.where(is_start & is_giant, load_f, 0.0).sum(),
+                              AXIS)
+    # Each device owns ~1/D of every giant line's dependents.
+    g_share = jnp.minimum(giant_load / num_dev, jnp.float32(pairs.SAT))
+
+    overflow = jnp.stack([ovf_f, ovf_a])
+    plan = jnp.stack([cap_b, cap_p, cap_g, g_share.astype(jnp.int32)])
+    return (jv, code, v1, v2, jnp.full(1, n_rows, jnp.int32), plan, overflow)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "projections", "use_fis", "use_ars", "cap_freq",
+                     "cap_exchange_a"))
+def _lines_step(triples, n_valid, min_support, *, mesh, projections, use_fis,
+                use_ars, cap_freq, cap_exchange_a):
+    fn = functools.partial(_lines_device, projections=projections,
+                           use_fis=use_fis, use_ars=use_ars, cap_freq=cap_freq,
+                           cap_exchange_a=cap_exchange_a)
+    return jax.shard_map(fn, mesh=mesh,
+                         in_specs=(P(AXIS, None), P(AXIS), P()),
+                         out_specs=P(AXIS), check_vma=False)(
+        triples, n_valid, min_support)
+
+
+# ---------------------------------------------------------------------------
+# Capture table (P3): exchange B support counting at the capture owner.
+# ---------------------------------------------------------------------------
+
+
+def _captures_device(jv, code, v1, v2, n_rows, *, cap_exchange_b):
+    num_dev = jax.lax.psum(1, AXIS)
+    n = jv.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
+    cap_bucket = hashing.bucket_of([code, v1, v2], num_dev, seed=_SEED_CAPTURE)
     ccols, cvalid, ovf_b = exchange.bucket_exchange([code, v1, v2], valid,
-                                                     cap_bucket, AXIS, cap_exchange_b)
+                                                    cap_bucket, AXIS,
+                                                    cap_exchange_b)
     tbl_cols, tbl_valid, tbl_inv, n_caps = segments.masked_unique(ccols, cvalid)
     tbl_counts = _masked_counts(cvalid, tbl_inv, tbl_cols[0].shape[0])
+    return (tbl_cols[0], tbl_cols[1], tbl_cols[2], tbl_counts,
+            jnp.full(1, n_caps, jnp.int32), jnp.full(1, ovf_b, jnp.int32))
 
-    # --- Skew stats: per-line quadratic load + global average (f32: loads overflow
+
+@functools.partial(jax.jit, static_argnames=("mesh", "cap_exchange_b"))
+def _captures_step(jv, code, v1, v2, n_rows, *, mesh, cap_exchange_b):
+    fn = functools.partial(_captures_device, cap_exchange_b=cap_exchange_b)
+    return jax.shard_map(fn, mesh=mesh,
+                         in_specs=(P(AXIS),) * 5,
+                         out_specs=P(AXIS), check_vma=False)(
+        jv, code, v1, v2, n_rows)
+
+
+# ---------------------------------------------------------------------------
+# Pair phase (shared): skew-aware masked pair counting + exchange C merge.
+# ---------------------------------------------------------------------------
+
+
+def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
+                cap_exchange_c, cap_giant, cap_giant_pairs):
+    """Skew-aware masked pair counting over value-sorted line rows.
+
+    Emits all ordered co-occurrence pairs whose dependent row is dep-flagged and
+    partner row is ref-flagged (AllAtOnce passes all-valid flags; SmallToLarge
+    passes the level's candidate flags), splitting oversized lines across the
+    mesh, then routes pair partials to the dependent capture's owner (seed 2)
+    and merges counts there.
+
+    Returns (ucols(6), uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp),
+    n_giant_lines, n_giant_pairs, n_pairs_total).
+    """
+    num_dev = jax.lax.psum(1, AXIS)
+    my_idx = jax.lax.axis_index(AXIS)
+    n = jv.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < n_rows
+    dep_f = dep_f & valid
+    ref_f = ref_f & valid
+
+    # Skew stats: per-line quadratic load + global average (f32: loads overflow
     # int32 long before they overflow the threshold math's precision needs).
     pos, length, start_idx, _ = pairs.line_layout(jv, n_rows)
     is_start = valid & (pos == 0)
@@ -118,18 +327,21 @@ def _device_step(triples, n_valid, min_support, *, projections,
     is_giant = valid & (load_f > thresh)
     n_giant_lines = jax.lax.psum((is_start & is_giant).sum(), AXIS)
 
-    # --- Pair emission for normal lines (giant rows get length 1 => no pairs).
+    # Pair emission for normal lines (giant rows get length 1 => no pairs).
     length_n = jnp.where(is_giant, 1, length)
     total_norm = pairs.saturating_cumsum(jnp.where(valid, length_n - 1, 0))[-1]
     ovf_p = jax.lax.psum(jnp.maximum(total_norm - cap_pairs, 0), AXIS)
     row, partner, pvalid = pairs.emit_pair_indices(pos, length_n, start_idx,
                                                    cap_pairs)
-    # --- Giant lines: extract whole lines, all_gather, process an owned dep slice.
+    pvalid = pvalid & dep_f[row] & ref_f[partner]
+
+    # Giant lines: extract whole lines, all_gather, process an owned dep slice.
     # Giant rows are a subset of the line rows, so the giant buffer never needs
     # to exceed the row buffer (also guards slicing below: c[:cap] must not
-    # clamp shorter than g_valid's arange).
-    cap_giant = min(cap_giant, jv.shape[0])
-    g_cols, n_g = segments.compact([jv, code, v1, v2], is_giant)
+    # clamp shorter than g_valid's arange).  Flags ride along packed in one lane.
+    cap_giant = min(cap_giant, n)
+    flag = dep_f.astype(jnp.int32) * 2 + ref_f.astype(jnp.int32)
+    g_cols, n_g = segments.compact([jv, code, v1, v2, flag], is_giant)
     ovf_g = jax.lax.psum(jnp.maximum(n_g - cap_giant, 0), AXIS)
     g_valid = jnp.arange(cap_giant, dtype=jnp.int32) < n_g
     gg = [jax.lax.all_gather(c[:cap_giant], AXIS, tiled=True) for c in g_cols]
@@ -137,10 +349,13 @@ def _device_step(triples, n_valid, min_support, *, projections,
     # Regroup gathered rows by line (jv is globally unique per line, so sorting by
     # it alone re-forms whole lines; in-line order is irrelevant to rotations).
     permg = segments.lexsort([jnp.where(gg_valid, gg[0], SENTINEL)])
-    jv_g, code_g, v1_g, v2_g = (c[permg] for c in gg)
+    jv_g, code_g, v1_g, v2_g, flag_g = (c[permg] for c in gg)
     gv = gg_valid[permg]
+    dep_fg = gv & (flag_g >= 2)
+    ref_fg = gv & (flag_g % 2 == 1)
     posg, leng, startg, _ = pairs.line_layout(jv_g, gv.sum())
-    own = gv & (hashing.bucket_of([code_g, v1_g, v2_g], num_dev, seed=5) == my_idx)
+    own = dep_fg & (hashing.bucket_of([code_g, v1_g, v2_g], num_dev,
+                                      seed=_SEED_GIANT) == my_idx)
     (posd, lend, startd, dc, dv1, dv2), n_own = segments.compact(
         [posg, leng, startg, code_g, v1_g, v2_g], own)
     lend = jnp.where(jnp.arange(lend.shape[0], dtype=jnp.int32) < n_own, lend, 1)
@@ -148,9 +363,11 @@ def _device_step(triples, n_valid, min_support, *, projections,
     ovf_gp = jax.lax.psum(jnp.maximum(total_g - cap_giant_pairs, 0), AXIS)
     growp, gpart, gpvalid = pairs.emit_pair_indices(posd, lend, startd,
                                                     cap_giant_pairs)
+    gpvalid = gpvalid & ref_fg[gpart]
     n_giant_pairs = jax.lax.psum(total_g, AXIS)
+    n_pairs_total = jax.lax.psum(total_norm, AXIS) + n_giant_pairs
 
-    # --- Local partial counts over the combined (normal + giant-slice) stream.
+    # Local partial counts over the combined (normal + giant-slice) stream.
     pair_cols = [jnp.concatenate([a[row], b[growp]])
                  for a, b in ((code, dc), (v1, dv1), (v2, dv2))]
     pair_cols += [jnp.concatenate([a[partner], b[gpart]])
@@ -159,20 +376,37 @@ def _device_step(triples, n_valid, min_support, *, projections,
     pcols, pvalid2, pinv, _ = segments.masked_unique(pair_cols, pvalid_all)
     pcnt = _masked_counts(pvalid_all, pinv, pcols[0].shape[0])
 
-    # --- Exchange C: co-locate pair partials with the dependent capture's owner.
-    pair_bucket = hashing.bucket_of(pcols[0:3], num_dev, seed=2)
+    # Exchange C: co-locate pair partials with the dependent capture's owner.
+    pair_bucket = hashing.bucket_of(pcols[0:3], num_dev, seed=_SEED_CAPTURE)
     mcols, mvalid, ovf_c = exchange.bucket_exchange(pcols + [pcnt], pvalid2,
-                                                    pair_bucket, AXIS, cap_exchange_c)
+                                                    pair_bucket, AXIS,
+                                                    cap_exchange_c)
     mkeys, mcnt_in = mcols[0:6], mcols[6]
 
-    # --- Merge partial counts across sources.
+    # Merge partial counts across sources.
     ucols, uvalid, uinv, _ = segments.masked_unique(mkeys, mvalid)
     m = ucols[0].shape[0]
     cooc = jax.ops.segment_sum(jnp.where(mvalid, mcnt_in, 0),
                                jnp.clip(uinv, 0, m - 1), num_segments=m)
+    return (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp),
+            n_giant_lines, n_giant_pairs, n_pairs_total)
 
-    # --- Support lookup + CIND test (same-device by shared hash seed=2).
-    dep_count = exchange.sorted_join_counts(tbl_cols, tbl_counts, tbl_valid,
+
+def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
+                 min_support, *, cap_pairs, cap_exchange_c, cap_giant,
+                 cap_giant_pairs):
+    """AllAtOnce finish: all-flag pair phase + support join + CIND test."""
+    n = jv.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
+    (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp), n_giant_lines,
+     n_giant_pairs, _) = _pair_phase(
+        jv, code, v1, v2, n_rows[0], valid, valid, cap_pairs=cap_pairs,
+        cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
+        cap_giant_pairs=cap_giant_pairs)
+
+    # Support lookup + CIND test (same-device by shared hash _SEED_CAPTURE).
+    tbl_valid = jnp.arange(tc.shape[0], dtype=jnp.int32) < n_caps[0]
+    dep_count = exchange.sorted_join_counts([tc, tv1, tv2], tcnt, tbl_valid,
                                             ucols[0:3], uvalid)
     is_cind = uvalid & (cooc == dep_count) & (dep_count >= min_support)
 
@@ -182,9 +416,7 @@ def _device_step(triples, n_valid, min_support, *, projections,
     keep = is_cind & ~implied
 
     out_cols, n_out = segments.compact(list(ucols) + [dep_count], keep)
-    # Per-site overflow counts (already psum'd => replicated): callers grow only
-    # the capacities that actually overflowed.
-    overflow = jnp.stack([ovf_a, ovf_b, ovf_p, ovf_c, ovf_g, ovf_gp])
+    overflow = jnp.stack([ovf_p, ovf_c, ovf_g, ovf_gp])
     return (*out_cols, jnp.full(1, n_out, jnp.int32), overflow,
             jnp.full(1, n_giant_lines, jnp.int32),
             jnp.full(1, n_giant_pairs, jnp.int32))
@@ -192,42 +424,28 @@ def _device_step(triples, n_valid, min_support, *, projections,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "projections", "cap_exchange_a", "cap_exchange_b",
-                     "cap_pairs", "cap_exchange_c", "cap_giant",
+    static_argnames=("mesh", "cap_pairs", "cap_exchange_c", "cap_giant",
                      "cap_giant_pairs"))
-def _sharded_step(triples, n_valid, min_support, *, mesh, projections,
-                  cap_exchange_a, cap_exchange_b, cap_pairs, cap_exchange_c,
-                  cap_giant, cap_giant_pairs):
-    fn = functools.partial(
-        _device_step, projections=projections, cap_exchange_a=cap_exchange_a,
-        cap_exchange_b=cap_exchange_b, cap_pairs=cap_pairs,
-        cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
-        cap_giant_pairs=cap_giant_pairs)
-    return jax.shard_map(
-        fn, mesh=mesh,
-        in_specs=(P(AXIS, None), P(AXIS), P()),
-        out_specs=P(AXIS),
-        check_vma=False,
-    )(triples, n_valid, min_support)
+def _cind_step(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
+               min_support, *, mesh, cap_pairs, cap_exchange_c, cap_giant,
+               cap_giant_pairs):
+    fn = functools.partial(_cind_device, cap_pairs=cap_pairs,
+                           cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
+                           cap_giant_pairs=cap_giant_pairs)
+    return jax.shard_map(fn, mesh=mesh,
+                         in_specs=(P(AXIS),) * 10 + (P(),),
+                         out_specs=P(AXIS), check_vma=False)(
+        jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps, min_support)
 
 
-def discover_sharded(triples, min_support: int, mesh=None, projections: str = "spo",
-                     clean_implied: bool = False,
-                     max_retries: int = 3, stats: dict | None = None) -> CindTable:
-    """Discover all CINDs with the full step sharded over `mesh` (default: all devices).
+# ---------------------------------------------------------------------------
+# Host orchestration.
+# ---------------------------------------------------------------------------
 
-    Output is identical to models.allatonce.discover.  If `stats` is a dict it
-    receives skew-engine counters (n_giant_lines, n_giant_pairs).
-    """
-    if mesh is None:
-        mesh = make_mesh()
-    num_dev = mesh.devices.size
-    triples = np.asarray(triples, np.int32)
+
+def _shard_triples(triples, num_dev):
+    """Contiguous per-device split, padded to a shared power-of-two block."""
     n = triples.shape[0]
-    if n == 0 or not any(ch in projections for ch in "spo"):
-        return CindTable.empty()
-    min_support = max(int(min_support), 1)
-
     t_loc = segments.pow2_capacity(-(-n // num_dev))
     padded = np.full((num_dev * t_loc, 3), np.iinfo(np.int32).max, np.int32)
     n_valid = np.zeros(num_dev, np.int32)
@@ -235,67 +453,348 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
         lo, hi = dev * t_loc, min((dev + 1) * t_loc, n)
         hi = max(hi, lo)
         take = triples[lo:hi] if lo < n else triples[:0]
-        # Contiguous split: device `dev` gets rows [dev*t_loc, (dev+1)*t_loc).
         padded[dev * t_loc: dev * t_loc + take.shape[0]] = take
         n_valid[dev] = take.shape[0]
+    return padded, n_valid, t_loc
 
-    # Generous first-try capacities (worst case: everything lands on one device);
-    # doubled on overflow.  Real deployments plan these from data statistics.
-    n_cand = 3 * sum(ch in "spo" for ch in projections) * t_loc
-    cap_a = segments.pow2_capacity(n_cand)
-    cap_b = segments.pow2_capacity(num_dev * cap_a)
-    cap_p = segments.pow2_capacity(4 * num_dev * cap_a)
-    cap_c = cap_p
-    cap_g = segments.pow2_capacity(max(256, cap_a // 8))
-    # Each device owns ~1/D of every giant line's dependents, so the per-device
-    # giant-pair budget can sit below the normal budget (capped at 1/4 — the
-    # overflow-retry loop is the safety net for heavier-than-expected skew).
-    # Keeping it small matters: the combined pair stream (cap_p + cap_gp rows)
-    # is what the hot-path dedup sort runs over.
-    cap_gp = max(cap_p // min(num_dev, 4), 1 << 10)
 
-    site_names = ("exchange_a", "exchange_b", "pairs", "exchange_c",
-                  "giant_rows", "giant_pairs")
-    for attempt in range(max_retries):
-        out = _sharded_step(
-            jnp.asarray(padded), jnp.asarray(n_valid), jnp.int32(min_support),
-            mesh=mesh, projections=projections, cap_exchange_a=cap_a,
-            cap_exchange_b=cap_b, cap_pairs=cap_p, cap_exchange_c=cap_c,
-            cap_giant=cap_g, cap_giant_pairs=cap_gp)
-        *cols, n_out, overflow, n_giant_lines, n_giant_pairs = out
-        # (num_dev, 6), identical rows (psum'd inside the step).
-        ovf = np.asarray(overflow).reshape(num_dev, 6)[0]
-        if int(ovf.sum()) == 0:
-            break
-        # Grow only what overflowed, past the deficit in one step.
-        caps = [cap_a, cap_b, cap_p, cap_c, cap_g, cap_gp]
-        for i in range(6):
-            if ovf[i] > 0:
-                caps[i] = segments.pow2_capacity(2 * caps[i] + int(ovf[i]))
-        cap_a, cap_b, cap_p, cap_c, cap_g, cap_gp = caps
-    else:
-        detail = ", ".join(f"{n}={int(v)}" for n, v in zip(site_names, ovf) if v)
-        raise RuntimeError(
-            f"bucket-exchange overflow persisted after {max_retries} retries "
-            f"({detail})")
-    if stats is not None:
-        stats["n_giant_lines"] = int(np.asarray(n_giant_lines)[0])
-        stats["n_giant_pairs"] = int(np.asarray(n_giant_pairs)[0])
+def _headroom(measured: int, floor: int = 64) -> int:
+    """Measured load -> planned capacity: +12.5% margin, pow2-bucketed (compiled
+    programs are reused across runs whose loads land in the same bucket)."""
+    measured = int(measured)
+    return segments.pow2_capacity(max(measured + max(measured // 8, floor),
+                                      floor))
 
-    # Collect per-device outputs: cols are (num_dev * block,) arrays.
-    cols = [np.asarray(c) for c in cols]
-    n_out = np.asarray(n_out)
-    block = cols[0].shape[0] // num_dev
-    keep = np.zeros(cols[0].shape[0], bool)
-    for dev in range(num_dev):
-        keep[dev * block: dev * block + int(n_out[dev])] = True
-    d_code, d_v1, d_v2, r_code, r_v1, r_v2, support = (c[keep] for c in cols)
+
+class _Pipeline:
+    """Planned, retrying execution of the sharded programs (host side).
+
+    Holds the device-resident line rows + capture table and the capacity plan.
+    Every stage checks its psum'd overflow counters and retries with grown
+    capacities — the plan is the fast path, retry the safety net.
+    """
+
+    def __init__(self, mesh, triples, min_support, projections, use_fis,
+                 use_ars, max_retries, stats):
+        self.mesh = mesh
+        self.num_dev = mesh.devices.size
+        self.min_support = min_support
+        self.max_retries = max_retries
+        self.stats = stats
+        padded, n_valid, _ = _shard_triples(triples, self.num_dev)
+        self._triples = jnp.asarray(padded)
+        self._n_valid = jnp.asarray(n_valid)
+
+        # P1: measured plan for the pre-exchange capacities.
+        cap_f, cap_a = _plan_step(self._triples, self._n_valid, mesh=mesh,
+                                  projections=projections, use_fis=use_fis)
+        self.cap_f = _headroom(np.asarray(cap_f)[0]) if use_fis else 1
+        self.cap_a = _headroom(np.asarray(cap_a)[0])
+
+        # P2: lines + downstream load measurement (retry on freq/A overflow).
+        for _ in range(max_retries):
+            out = _lines_step(
+                self._triples, self._n_valid, jnp.int32(min_support),
+                mesh=mesh, projections=projections, use_fis=use_fis,
+                use_ars=use_ars, cap_freq=self.cap_f, cap_exchange_a=self.cap_a)
+            *line_cols, n_rows, plan, overflow = out
+            ovf = np.asarray(overflow).reshape(self.num_dev, 2)[0]
+            if int(ovf.sum()) == 0:
+                break
+            if ovf[0] > 0:
+                self.cap_f = segments.pow2_capacity(2 * self.cap_f + int(ovf[0]))
+            if ovf[1] > 0:
+                self.cap_a = segments.pow2_capacity(2 * self.cap_a + int(ovf[1]))
+        else:
+            raise RuntimeError(
+                f"line-building overflow persisted after {max_retries} retries "
+                f"(freq={int(ovf[0])}, exchange_a={int(ovf[1])})")
+        self.lines = line_cols  # jv, code, v1, v2 — device-resident
+        self.n_rows = n_rows
+        plan = np.asarray(plan).reshape(self.num_dev, 4)[0]
+        self.cap_b = _headroom(plan[0])
+        self.cap_p = _headroom(plan[1], floor=1 << 10)
+        self.cap_g = _headroom(plan[2])
+        self.cap_gp = _headroom(2 * int(plan[3]), floor=1 << 10)
+        self.cap_c = segments.pow2_capacity(self.cap_p + self.cap_gp)
+
+        # P3: capture table (retry on B overflow).
+        for _ in range(max_retries):
+            out = _captures_step(*self.lines, self.n_rows, mesh=mesh,
+                                 cap_exchange_b=self.cap_b)
+            *tbl, n_caps, ovf_b = out
+            ovf_b = int(np.asarray(ovf_b)[0])
+            if ovf_b == 0:
+                break
+            self.cap_b = segments.pow2_capacity(2 * self.cap_b + ovf_b)
+        else:
+            raise RuntimeError(
+                f"capture-count overflow persisted after {max_retries} retries "
+                f"(exchange_b={ovf_b})")
+        self.tbl = tbl  # tc, tv1, tv2, tcnt — device-resident, capture-owned
+        self.n_caps = n_caps
+        if stats is not None:
+            stats["planned_caps"] = dict(
+                freq=self.cap_f, exchange_a=self.cap_a, exchange_b=self.cap_b,
+                pairs=self.cap_p, exchange_c=self.cap_c, giant_rows=self.cap_g,
+                giant_pairs=self.cap_gp)
+
+    def _pair_caps(self):
+        return dict(cap_pairs=self.cap_p, cap_exchange_c=self.cap_c,
+                    cap_giant=self.cap_g, cap_giant_pairs=self.cap_gp)
+
+    def _grow_pair_caps(self, ovf):
+        if ovf[0] > 0:
+            self.cap_p = segments.pow2_capacity(2 * self.cap_p + int(ovf[0]))
+        if ovf[1] > 0:
+            self.cap_c = segments.pow2_capacity(2 * self.cap_c + int(ovf[1]))
+        if ovf[2] > 0:
+            self.cap_g = segments.pow2_capacity(2 * self.cap_g + int(ovf[2]))
+        if ovf[3] > 0:
+            self.cap_gp = segments.pow2_capacity(2 * self.cap_gp + int(ovf[3]))
+
+    def collect_blocks(self, cols, n_out):
+        """Per-device compacted outputs -> host rows."""
+        cols = [np.asarray(c) for c in cols]
+        n_out = np.asarray(n_out)
+        block = cols[0].shape[0] // self.num_dev
+        keep = np.zeros(cols[0].shape[0], bool)
+        for dev in range(self.num_dev):
+            keep[dev * block: dev * block + int(n_out[dev])] = True
+        return [c[keep] for c in cols]
+
+    def capture_table(self):
+        """Host capture table in canonical (code, v1, v2) order.  Each distinct
+        capture lives on exactly one device (hash-routed): no duplicates."""
+        tc, tv1, tv2, tcnt = self.collect_blocks(self.tbl, self.n_caps)
+        cap_code = tc.astype(np.int64)
+        cap_v1 = tv1.astype(np.int64)
+        cap_v2 = tv2.astype(np.int64)
+        dep_count = tcnt.astype(np.int64)
+        order = np.lexsort((cap_v2, cap_v1, cap_code))
+        return (cap_code[order], cap_v1[order], cap_v2[order], dep_count[order])
+
+    def run_cinds(self):
+        """AllAtOnce finish over the device-resident lines."""
+        for _ in range(self.max_retries):
+            out = _cind_step(*self.lines, self.n_rows, *self.tbl, self.n_caps,
+                             jnp.int32(self.min_support), mesh=self.mesh,
+                             **self._pair_caps())
+            *cols, n_out, overflow, ngl, ngp = out
+            ovf = np.asarray(overflow).reshape(self.num_dev, 4)[0]
+            if int(ovf.sum()) == 0:
+                break
+            self._grow_pair_caps(ovf)
+        else:
+            raise RuntimeError(
+                f"pair-phase overflow persisted after {self.max_retries} "
+                f"retries ({ovf.tolist()})")
+        if self.stats is not None:
+            self.stats["n_giant_lines"] = int(np.asarray(ngl)[0])
+            self.stats["n_giant_pairs"] = int(np.asarray(ngp)[0])
+        return self.collect_blocks(cols, n_out)
+
+    def run_cooc(self, fcode, fv1, fv2, fflag, n_flags, stat_key):
+        """S2L level verification over the device-resident lines."""
+        for _ in range(self.max_retries):
+            out = _s2l_cooc(*self.lines, self.n_rows, fcode, fv1, fv2, fflag,
+                            n_flags, mesh=self.mesh, **self._pair_caps())
+            *cols, n_out, overflow, ngl, ngp, npt = out
+            ovf = np.asarray(overflow).reshape(self.num_dev, 4)[0]
+            if int(ovf.sum()) == 0:
+                break
+            self._grow_pair_caps(ovf)
+        else:
+            raise RuntimeError(
+                f"sharded S2L cooc overflow persisted after "
+                f"{self.max_retries} retries ({ovf.tolist()})")
+        if self.stats is not None:
+            npt = int(np.asarray(npt)[0])
+            self.stats[stat_key] = npt
+            self.stats["total_pairs"] = self.stats.get("total_pairs", 0) + npt
+            self.stats["n_giant_lines"] = max(
+                self.stats.get("n_giant_lines", 0), int(np.asarray(ngl)[0]))
+            self.stats["n_giant_pairs"] = (
+                self.stats.get("n_giant_pairs", 0) + int(np.asarray(ngp)[0]))
+        return self.collect_blocks(cols, n_out)
+
+
+def discover_sharded(triples, min_support: int, mesh=None, projections: str = "spo",
+                     use_fis: bool = False, use_ars: bool = False,
+                     clean_implied: bool = False,
+                     max_retries: int = 4, stats: dict | None = None) -> CindTable:
+    """Discover all CINDs with the full AllAtOnce step sharded over `mesh`.
+
+    Output is identical to models.allatonce.discover with matching flags.  If
+    `stats` is a dict it receives skew-engine counters (n_giant_lines,
+    n_giant_pairs) and the measured capacity plan (planned_caps).
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    triples = np.asarray(triples, np.int32)
+    n = triples.shape[0]
+    if n == 0 or not any(ch in projections for ch in "spo"):
+        return CindTable.empty()
+    min_support = max(int(min_support), 1)
+    use_ars = use_ars and use_fis
+
+    pipe = _Pipeline(mesh, triples, min_support, projections, use_fis, use_ars,
+                     max_retries, stats)
+    d_code, d_v1, d_v2, r_code, r_v1, r_v2, support = pipe.run_cinds()
 
     table = CindTable(
         dep_code=d_code.astype(np.int64), dep_v1=d_v1.astype(np.int64),
         dep_v2=d_v2.astype(np.int64), ref_code=r_code.astype(np.int64),
         ref_v1=r_v1.astype(np.int64), ref_v2=r_v2.astype(np.int64),
         support=support.astype(np.int64))
+    if use_ars:
+        from . import allatonce
+        rules = frequency.mine_association_rules(triples, min_support)
+        if stats is not None:
+            stats["association_rules"] = rules
+        table = allatonce.filter_ar_implied_cinds(table, rules)
     if clean_implied:
         table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
     return table
+
+
+# ---------------------------------------------------------------------------
+# Sharded SmallToLarge: device-resident join lines + per-level flag broadcast.
+# ---------------------------------------------------------------------------
+
+
+def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
+                     *, cap_pairs, cap_exchange_c, cap_giant, cap_giant_pairs):
+    """One level's verification: join flags onto rows, masked pair phase."""
+    n = jv.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
+    fvalid = jnp.arange(fcode.shape[0], dtype=jnp.int32) < n_flags[0]
+    flags = exchange.sorted_join_counts([fcode, fv1, fv2], fflag, fvalid,
+                                        [code, v1, v2], valid)
+    dep_f = valid & (flags >= 2)
+    ref_f = valid & (flags % 2 == 1)
+    keep = dep_f | ref_f
+    # Dropping never-relevant rows BEFORE the quadratic layout is THE saving of
+    # this strategy (cf. small_to_large._chunked_cooc's row_keep).  compact
+    # preserves the (value, capture) sort order.
+    (jv2, code2, v12, v22, df2, rf2), n_keep = segments.compact(
+        [jv, code, v1, v2, dep_f, ref_f], keep)
+    (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp), n_giant_lines,
+     n_giant_pairs, n_pairs_total) = _pair_phase(
+        jv2, code2, v12, v22, n_keep, df2, rf2, cap_pairs=cap_pairs,
+        cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
+        cap_giant_pairs=cap_giant_pairs)
+    out_cols, n_out = segments.compact(list(ucols) + [cooc], uvalid)
+    overflow = jnp.stack([ovf_p, ovf_c, ovf_g, ovf_gp])
+    return (*out_cols, jnp.full(1, n_out, jnp.int32), overflow,
+            jnp.full(1, n_giant_lines, jnp.int32),
+            jnp.full(1, n_giant_pairs, jnp.int32),
+            jnp.full(1, n_pairs_total, jnp.int32))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "cap_pairs", "cap_exchange_c", "cap_giant",
+                     "cap_giant_pairs"))
+def _s2l_cooc(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags, *,
+              mesh, cap_pairs, cap_exchange_c, cap_giant, cap_giant_pairs):
+    fn = functools.partial(
+        _s2l_cooc_device, cap_pairs=cap_pairs, cap_exchange_c=cap_exchange_c,
+        cap_giant=cap_giant, cap_giant_pairs=cap_giant_pairs)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(AXIS),) * 5 + (P(),) * 5,
+        out_specs=P(AXIS),
+        check_vma=False,
+    )(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags)
+
+
+class _ShardedCooc:
+    """Host-side verification backend for the sharded SmallToLarge lattice.
+
+    Each cooc() call broadcasts the level's per-capture flags as a replicated
+    flag table (the analog of the reference's broadcast candidate Bloom
+    filters) and runs the masked pair phase on the mesh.
+    """
+
+    def __init__(self, pipe: _Pipeline, cap_table):
+        self.pipe = pipe
+        self.cap_code, self.cap_v1, self.cap_v2, self.dep_count = cap_table
+
+    def cooc(self, dep_ok, ref_ok, stat_key):
+        """Global (dep, ref) -> co-occurrence counts for flagged capture pairs."""
+        sel = np.flatnonzero(dep_ok | ref_ok)
+        z = np.zeros(0, np.int64)
+        if sel.size == 0:
+            return z, z, z
+        flag = dep_ok[sel].astype(np.int32) * 2 + ref_ok[sel].astype(np.int32)
+        cap_f = segments.pow2_capacity(sel.size)
+        pad = lambda a, fill: np.concatenate(
+            [a, np.full(cap_f - a.shape[0], fill, a.dtype)])
+        fcode = jnp.asarray(pad(self.cap_code[sel].astype(np.int32), SENTINEL))
+        fv1 = jnp.asarray(pad(self.cap_v1[sel].astype(np.int32), SENTINEL))
+        fv2 = jnp.asarray(pad(self.cap_v2[sel].astype(np.int32), SENTINEL))
+        fflag = jnp.asarray(pad(flag, 0))
+        n_flags = jnp.full(1, sel.size, jnp.int32)
+
+        d_code, d_v1, d_v2, r_code, r_v1, r_v2, cnt = self.pipe.run_cooc(
+            fcode, fv1, fv2, fflag, n_flags, stat_key)
+        from .small_to_large import _lookup_capture_ids
+        d = _lookup_capture_ids(self.cap_code, self.cap_v1, self.cap_v2,
+                                d_code.astype(np.int64), d_v1.astype(np.int64),
+                                d_v2.astype(np.int64))
+        r = _lookup_capture_ids(self.cap_code, self.cap_v1, self.cap_v2,
+                                r_code.astype(np.int64), r_v1.astype(np.int64),
+                                r_v2.astype(np.int64))
+        ok = (d >= 0) & (r >= 0)
+        return d[ok], r[ok], cnt[ok].astype(np.int64)
+
+
+def discover_sharded_s2l(triples, min_support: int, mesh=None,
+                         projections: str = "spo", use_fis: bool = True,
+                         use_ars: bool = False, clean_implied: bool = False,
+                         max_retries: int = 4,
+                         stats: dict | None = None) -> CindTable:
+    """Sharded SmallToLarge: the reference's default strategy on the mesh.
+
+    Join lines are built once and stay device-resident; the host drives the
+    identical lattice logic as small_to_large.discover (shared code), with each
+    level's verification running as a masked pair phase over the mesh.  Output
+    is identical to small_to_large.discover with matching flags.
+    """
+    from . import small_to_large
+
+    if mesh is None:
+        mesh = make_mesh()
+    triples = np.asarray(triples, np.int32)
+    n = triples.shape[0]
+    if n == 0 or not any(ch in projections for ch in "spo"):
+        return CindTable.empty()
+    min_support = max(int(min_support), 1)
+    use_ars = use_ars and use_fis
+
+    pipe = _Pipeline(mesh, triples, min_support, projections, use_fis, use_ars,
+                     max_retries, stats)
+    cap_code, cap_v1, cap_v2, dep_count = pipe.capture_table()
+    # Frequent captures only (the single-device capture filter; infrequent ones
+    # can appear in no CIND on either side).
+    freq_cap = dep_count >= min_support
+    cap_code, cap_v1, cap_v2, dep_count = (
+        a[freq_cap] for a in (cap_code, cap_v1, cap_v2, dep_count))
+    num_caps = cap_code.shape[0]
+    if num_caps == 0:
+        return CindTable.empty()
+
+    if stats is not None:
+        stats.update(n_triples=n, n_captures=num_caps, total_pairs=0)
+
+    backend = _ShardedCooc(pipe, (cap_code, cap_v1, cap_v2, dep_count))
+
+    rules = (frequency.mine_association_rules(triples, min_support)
+             if use_ars else None)
+    if use_ars and stats is not None:
+        stats["association_rules"] = rules
+
+    return small_to_large._run_lattice(
+        backend.cooc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
+        min_support, use_ars, rules, clean_implied, stats)

@@ -275,16 +275,37 @@ def discover(triples, min_support: int, projections: str = "spo",
     line_val_h, line_cap_h = st["line_val_h"], st["line_cap_h"]
     cap_code, cap_v1, cap_v2 = st["cap_code"], st["cap_v1"], st["cap_v2"]
     dep_count, num_caps = st["dep_count"], st["num_caps"]
-    unary = np.asarray(cc.is_unary(cap_code))
+
+    def cooc_fn(dep_ok, ref_ok, stat_key):
+        return _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok,
+                             pair_chunk_budget, stats, stat_key)
 
     rules = (frequency.mine_association_rules(triples, min_support)
              if use_ars else None)
     if use_ars and stats is not None:
         stats["association_rules"] = rules  # driver --ar-output reuses these
 
+    return _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count, num_caps,
+                        min_support, use_ars, rules, clean_implied, stats)
+
+
+def _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count, num_caps,
+                 min_support, use_ars, rules, clean_implied,
+                 stats) -> CindTable:
+    """The S2L lattice walk, generic over the verification backend.
+
+    cooc_fn(dep_ok, ref_ok, stat_key) -> (dep_id, ref_id, count): global merged
+    co-occurrence counts for flagged capture pairs.  The single-device backend
+    is the chunked device loop over host join lines (_chunked_cooc); the
+    multi-device backend is models.sharded._ShardedCooc (flag broadcast + masked
+    pair phase over the mesh).  Everything else — candidate generation, pruning,
+    assembly — is identical host logic, which is what makes the two strategies
+    differentially testable against each other.
+    """
+    unary = np.asarray(cc.is_unary(cap_code))
+
     # --- Level 1/1: unary-unary overlaps (findFrequentSingleSingleConditionOverlaps).
-    d11, r11, c11cnt = _chunked_cooc(line_val_h, line_cap_h, unary, unary,
-                                     pair_chunk_budget, stats, "pairs_11")
+    d11, r11, c11cnt = cooc_fn(unary, unary, "pairs_11")
     # Frequent overlaps only (findFrequentUnaryUnaryOverlapsDirectly's
     # rhs-count filter); lhs frequency is guaranteed by the capture filter.
     freq_ov = c11cnt >= min_support
@@ -322,16 +343,16 @@ def discover(triples, min_support: int, projections: str = "spo",
     ok = c12_cand_ref >= 0  # merged capture exists (and is frequent)
     c12_cand_dep, c12_cand_ref = c12_cand_dep[ok], c12_cand_ref[ok]
     cind12_d, cind12_r, cind12_sup = _verify_level(
-        line_val_h, line_cap_h, c12_cand_dep, c12_cand_ref, num_caps, dep_count,
-        cap_code, cap_v1, cap_v2, min_support, pair_chunk_budget, stats, "pairs_12")
+        cooc_fn, c12_cand_dep, c12_cand_ref, num_caps, dep_count,
+        cap_code, cap_v1, cap_v2, min_support, "pairs_12")
 
     # --- Level 2/1 (findDoubleSingleCindSets): candidates from pairs of proper
     # overlaps sharing the referenced capture (GenerateBinaryUnaryCindCandidates).
     c21_cand_dep, c21_cand_ref = _generate_2x_deps(
         prop_r, prop_d, cap_code, cap_v1, cap_v2, require_cind=None)
     cind21_d, cind21_r, cind21_sup = _verify_level(
-        line_val_h, line_cap_h, c21_cand_dep, c21_cand_ref, num_caps, dep_count,
-        cap_code, cap_v1, cap_v2, min_support, pair_chunk_budget, stats, "pairs_21")
+        cooc_fn, c21_cand_dep, c21_cand_ref, num_caps, dep_count,
+        cap_code, cap_v1, cap_v2, min_support, "pairs_21")
 
     # --- Inferred non-minimal 2/1s (InferDoubleSingleCinds): pairs of {1/1 CINDs
     # (marked), proper overlaps} on the same ref with >= 1 CIND.  Frequency of the
@@ -374,8 +395,8 @@ def discover(triples, min_support: int, projections: str = "spo",
                            cap_code, cap_v1, cap_v2)
     c22_cand_dep, c22_cand_ref = c22_cand_dep[keep], c22_cand_ref[keep]
     cind22_d, cind22_r, cind22_sup = _verify_level(
-        line_val_h, line_cap_h, c22_cand_dep, c22_cand_ref, num_caps, dep_count,
-        cap_code, cap_v1, cap_v2, min_support, pair_chunk_budget, stats, "pairs_22")
+        cooc_fn, c22_cand_dep, c22_cand_ref, num_caps, dep_count,
+        cap_code, cap_v1, cap_v2, min_support, "pairs_22")
 
     if stats is not None:
         stats.update(n_cinds_12=len(cind12_d), n_cinds_21=len(cind21_d),
@@ -427,8 +448,8 @@ def _generate_2x_deps(group_ref, member_dep, cap_code, cap_v1, cap_v2,
     return both[:, 0], both[:, 1]
 
 
-def _verify_level(line_val_h, line_cap_h, cand_dep, cand_ref, num_caps, dep_count,
-                  cap_code, cap_v1, cap_v2, min_support, budget, stats, stat_key):
+def _verify_level(cooc_fn, cand_dep, cand_ref, num_caps, dep_count,
+                  cap_code, cap_v1, cap_v2, min_support, stat_key):
     """Verify candidate (dep, ref) pairs against the join lines by counting.
 
     CIND iff cooc(dep, ref) == |dep| (>= min_support by the capture filter).
@@ -441,8 +462,7 @@ def _verify_level(line_val_h, line_cap_h, cand_dep, cand_ref, num_caps, dep_coun
     dep_ok[cand_dep] = True
     ref_ok = np.zeros(num_caps, bool)
     ref_ok[cand_ref] = True
-    d, r, cnt = _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok, budget,
-                              stats, stat_key)
+    d, r, cnt = cooc_fn(dep_ok, ref_ok, stat_key)
     d, r, cnt = _semi_join(d, r, cnt, cand_dep, cand_ref)
     is_cind = (cnt == dep_count[d]) & (dep_count[d] >= min_support)
     is_cind &= ~_implied_mask(d, r, cap_code, cap_v1, cap_v2)

@@ -121,17 +121,26 @@ def ar_implied_pair_mask(dep_code, ref_code, dep_v1, ref_v1, mined_rules):
     ants, cons, avs, cvs, _ = mined_rules
     if len(ants) == 0 or len(dep_code) == 0:
         return out
-    rules = set(zip(ants.tolist(), cons.tolist(), avs.tolist(), cvs.tolist()))
-    cand = np.asarray(cc.is_unary(dep_code) & cc.is_unary(ref_code)
-                      & (cc.secondary(dep_code) == cc.secondary(ref_code))
-                      & (cc.primary(dep_code) != cc.primary(ref_code)))
+    cand = np.flatnonzero(
+        np.asarray(cc.is_unary(dep_code) & cc.is_unary(ref_code)
+                   & (cc.secondary(dep_code) == cc.secondary(ref_code))
+                   & (cc.primary(dep_code) != cc.primary(ref_code))))
+    if cand.size == 0:
+        return out
     dep_v1 = np.asarray(dep_v1)
     ref_v1 = np.asarray(ref_v1)
-    for i in np.flatnonzero(cand):
-        key = (int(cc.primary(int(dep_code[i]))), int(cc.primary(int(ref_code[i]))),
-               int(dep_v1[i]), int(ref_v1[i]))
-        if key in rules:
-            out[i] = True
+    # Membership of (ant_field, cons_field, ant_val, cons_val) rows in the rule
+    # table via one row-wise unique — a sorted join, no per-row interpreter work.
+    rule_rows = np.stack([ants, cons, avs, cvs], axis=1).astype(np.int64)
+    cand_rows = np.stack([
+        np.asarray(cc.primary(dep_code[cand]), np.int64),
+        np.asarray(cc.primary(ref_code[cand]), np.int64),
+        dep_v1[cand].astype(np.int64), ref_v1[cand].astype(np.int64)], axis=1)
+    allr = np.concatenate([rule_rows, cand_rows])
+    uniq, inv = np.unique(allr, axis=0, return_inverse=True)
+    in_rules = np.zeros(len(uniq), bool)
+    in_rules[inv[:len(rule_rows)]] = True
+    out[cand] = in_rules[inv[len(rule_rows):]]
     return out
 
 

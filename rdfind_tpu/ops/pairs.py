@@ -24,12 +24,16 @@ from __future__ import annotations
 import jax.lax
 import jax.numpy as jnp
 import jax.ops
+import numpy as np
 
 from . import segments
 
 # Saturation bound for pair-count prefix sums: large enough that any real capacity
 # is below it, small enough that a single add can never wrap int32.
-SAT = jnp.int32(1 << 30)
+# Plain int (not jnp.int32): a module-scope device array would initialize the
+# default backend at import time — on this image that is the remote-TPU tunnel,
+# which must not be touched by CPU-only runs (round-1 bench/dryrun hangs).
+SAT = np.int32(1 << 30)
 
 
 def saturating_cumsum(x):

@@ -62,6 +62,25 @@ def masked_row_counts(cols, valid):
     return jnp.zeros(n, jnp.int32).at[perm].set(per_row_sorted)
 
 
+def masked_weighted_row_counts(cols, weights, valid):
+    """For each row, the sum of `weights` over valid rows sharing its key.
+
+    The weighted generalization of masked_row_counts — the reduce side of a
+    distributed count whose combiner pre-summed local multiplicities.  Invalid
+    rows get 0.
+    """
+    n = cols[0].shape[0]
+    cols = [jnp.where(valid, c, SENTINEL) for c in cols]
+    perm = lexsort(cols)
+    sorted_cols = [c[perm] for c in cols]
+    v_sorted = valid[perm]
+    w_sorted = jnp.where(v_sorted, weights[perm], 0).astype(jnp.int32)
+    gid = jnp.cumsum(run_starts(sorted_cols)).astype(jnp.int32) - 1
+    sums = jax.ops.segment_sum(w_sorted, gid, num_segments=n)
+    per_row_sorted = sums[gid] * v_sorted.astype(jnp.int32)
+    return jnp.zeros(n, jnp.int32).at[perm].set(per_row_sorted)
+
+
 def masked_unique(cols, valid):
     """Distinct valid rows, compacted to the front in sorted key order.
 

@@ -8,20 +8,42 @@ buffers, so a shuffle becomes: sort rows by destination bucket, scatter into a
 SENTINEL fill.  Overflowing rows are counted (never silently dropped without notice):
 callers must check the psum'd overflow count and retry with a larger capacity.
 
+Round trips: all_to_all with split_axis=0/concat_axis=0 is slot-preserving —
+received row (src, k) on the owner came from src's send slot (owner, k) — so a
+reply column pushed back through the same collective lands exactly in the
+sender's send-buffer slots.  `route` exposes that slot mapping and `route_reply`
+rides it; `global_row_counts` uses the pair to implement the distributed
+group-by-count-join-back that powers the sharded frequency filter (the
+reference's broadcast Bloom-filter pruning, FrequentConditionPlanner.scala:
+201-283, recast as exact counts flowing back to the asking rows).
+
 All functions assume they run inside shard_map over a 1-D mesh axis.
 """
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 
-from ..ops import segments
+from ..ops import hashing, segments
 
 SENTINEL = segments.SENTINEL
 
 
-def bucket_exchange(cols, valid, bucket, axis_name: str, capacity: int):
+@dataclasses.dataclass
+class RouteState:
+    """Slot mapping of one routed exchange (everything route_reply needs)."""
+
+    perm: jnp.ndarray  # sorted order -> original row index
+    flat: jnp.ndarray  # per sorted row: slot in the (D*capacity) send buffer
+    ok: jnp.ndarray    # per sorted row: survived (valid and under capacity)
+    num_dev: int
+    capacity: int
+
+
+def route(cols, valid, bucket, axis_name: str, capacity: int):
     """Route rows to the device equal to their bucket id.
 
     cols     -- list of (N,) int32 columns (row payload; SENTINEL is reserved);
@@ -29,9 +51,10 @@ def bucket_exchange(cols, valid, bucket, axis_name: str, capacity: int):
     bucket   -- (N,) int32 destination device in [0, D);
     capacity -- static per-destination row budget.
 
-    Returns (out_cols, out_valid, overflow): out_cols are (D*capacity,) columns of
-    rows received by this device (garbage where ~out_valid); overflow is the global
-    number of rows dropped for exceeding a bucket capacity.
+    Returns (out_cols, out_valid, overflow, state): out_cols are (D*capacity,)
+    columns of rows received by this device (garbage where ~out_valid); overflow
+    is the global number of rows dropped for exceeding a bucket capacity; state
+    feeds route_reply for sending per-received-row answers back.
     """
     d = jax.lax.psum(1, axis_name)
     n = cols[0].shape[0]
@@ -63,7 +86,56 @@ def bucket_exchange(cols, valid, bucket, axis_name: str, capacity: int):
         ok.astype(jnp.int32)[perm], mode="drop").reshape(d, capacity)
     recv_v = jax.lax.all_to_all(vbuf, axis_name, split_axis=0, concat_axis=0,
                                 tiled=True)
-    return out_cols, recv_v.reshape(-1) == 1, overflow
+    state = RouteState(perm=perm, flat=flat, ok=ok, num_dev=d, capacity=capacity)
+    return out_cols, recv_v.reshape(-1) == 1, overflow, state
+
+
+def route_reply(answer, state: RouteState, axis_name: str):
+    """Send one (D*capacity,) int32 answer-per-received-row back to the senders.
+
+    Returns an (N,) column in the *original row order* of the route() call; rows
+    that were dropped (overflow) or invalid get 0.
+    """
+    n = state.perm.shape[0]
+    buf = answer.reshape(state.num_dev, state.capacity)
+    back = jax.lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True).reshape(-1)
+    safe = jnp.clip(state.flat, 0, state.num_dev * state.capacity - 1)
+    vals = jnp.where(state.ok, back[safe], 0)
+    return jnp.zeros(n, jnp.int32).at[state.perm].set(vals)
+
+
+def bucket_exchange(cols, valid, bucket, axis_name: str, capacity: int):
+    """route() without the reply half (the one-way shuffle)."""
+    out_cols, out_valid, overflow, _ = route(cols, valid, bucket, axis_name,
+                                             capacity)
+    return out_cols, out_valid, overflow
+
+
+def global_row_counts(key_cols, valid, axis_name: str, capacity: int, *,
+                      seed: int):
+    """Per-row GLOBAL count of the row's key across all devices.
+
+    Combiner-tree + join-back in one primitive: local distinct keys carry their
+    local multiplicities to the key's hash owner (one all_to_all of *distinct*
+    keys, not rows), the owner sums them, and the sums ride the reply collective
+    back to every asking row.  Exchange volume is O(local distinct keys).
+
+    Returns (counts, overflow): counts is (N,) int32, 0 for invalid rows;
+    overflow > 0 means `capacity` was too small and counts are unusable.
+    """
+    d = jax.lax.psum(1, axis_name)
+    u_cols, u_valid, inv, _ = segments.masked_unique(key_cols, valid)
+    m = u_cols[0].shape[0]
+    inv_safe = jnp.clip(inv, 0, m - 1)
+    local_mult = jax.ops.segment_sum(valid.astype(jnp.int32), inv_safe,
+                                     num_segments=m)
+    bucket = hashing.bucket_of(u_cols, d, seed=seed)
+    recv, recv_valid, overflow, state = route(u_cols + [local_mult], u_valid,
+                                              bucket, axis_name, capacity)
+    g = segments.masked_weighted_row_counts(recv[:-1], recv[-1], recv_valid)
+    ans_per_distinct = route_reply(g, state, axis_name)
+    return jnp.where(valid, ans_per_distinct[inv_safe], 0), overflow
 
 
 def sorted_join_counts(table_cols, table_counts, table_valid, query_cols, query_valid):

@@ -219,12 +219,29 @@ def run(cfg: Config) -> RunResult:
 
     def discover():
         if cfg.n_devices > 1:
-            if use_ars:
-                print("note: association rules not yet wired into the multi-device "
-                      "path; running without them", file=sys.stderr)
+            # Distributed strategy dispatch: 0 = sharded AllAtOnce, 1 = sharded
+            # SmallToLarge (the default, like the reference's distributed-by-
+            # construction plans).  The approximate strategies (2, 3) produce
+            # the same exact output as AllAtOnce by design, so multi-device runs
+            # of those fall back to the sharded AllAtOnce with a note.
             mesh = make_mesh(cfg.n_devices)
+            strategy = cfg.traversal_strategy
+            if strategy in (2, 3):
+                print(f"note: traversal strategy {strategy} (approximate) is "
+                      "not yet sharded; running the sharded AllAtOnce, which "
+                      "produces the identical exact output", file=sys.stderr)
+                strategy = 0
+            if strategy == 1:
+                return sharded.discover_sharded_s2l(
+                    ids, cfg.min_support, mesh=mesh,
+                    projections=cfg.projections,
+                    use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
+                    clean_implied=cfg.clean_implied, stats=stats)
+            if strategy != 0:
+                raise ValueError(f"unknown traversal strategy {strategy}")
             return sharded.discover_sharded(
                 ids, cfg.min_support, mesh=mesh, projections=cfg.projections,
+                use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
                 clean_implied=cfg.clean_implied, stats=stats)
         # Strategy dispatch (TraversalStrategy registry, RDFind.scala:50-56).
         strategy = STRATEGIES.get(cfg.traversal_strategy)

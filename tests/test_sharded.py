@@ -111,3 +111,138 @@ def test_skew_split_device_invariance(mesh8):
     for d in (1, 4, 8):
         got = sharded.discover_sharded(ids, 2, mesh=make_mesh(d)).to_rows()
         assert got == want, f"mismatch on {d}-device mesh"
+
+
+# ---------------------------------------------------------------------------
+# Distributed frequency filter + sharded SmallToLarge (round 2).
+# ---------------------------------------------------------------------------
+
+from rdfind_tpu.models import small_to_large  # noqa: E402
+
+
+@pytest.mark.parametrize("use_fis,use_ars",
+                         [(False, False), (True, False), (True, True)])
+def test_sharded_fis_ars_matches_single_chip(mesh8, use_fis, use_ars):
+    """The distributed frequency filter + AR suppression must be output-
+    identical to the single-device AllAtOnce with the same flags."""
+    triples = generate_triples(300, seed=9, n_predicates=6, n_entities=24)
+    a = sharded.discover_sharded(triples, 2, mesh=mesh8, use_fis=use_fis,
+                                 use_ars=use_ars)
+    b = allatonce.discover(triples, 2, use_frequent_condition_filter=use_fis,
+                           use_association_rules=use_ars)
+    assert a.to_rows() == b.to_rows()
+
+
+@pytest.mark.parametrize("min_support", [1, 3])
+@pytest.mark.parametrize("seed", range(2))
+def test_sharded_s2l_matches_single_chip(mesh8, seed, min_support):
+    """Sharded S2L (default strategy distributed) == single-device S2L."""
+    rng = random.Random(seed)
+    ids, _ = intern_triples(
+        np.asarray(random_triples(rng, 90, 6, 3, 5), dtype=object))
+    a = sharded.discover_sharded_s2l(ids, min_support, mesh=mesh8)
+    b = small_to_large.discover(ids, min_support)
+    assert a.to_rows() == b.to_rows()
+
+
+@pytest.mark.parametrize("use_fis,use_ars",
+                         [(False, False), (True, False), (True, True)])
+def test_sharded_s2l_flags(mesh8, use_fis, use_ars):
+    triples = generate_triples(250, seed=11, n_predicates=6, n_entities=20)
+    a = sharded.discover_sharded_s2l(triples, 2, mesh=mesh8, use_fis=use_fis,
+                                     use_ars=use_ars)
+    b = small_to_large.discover(triples, 2,
+                                use_frequent_condition_filter=use_fis,
+                                use_association_rules=use_ars)
+    assert a.to_rows() == b.to_rows()
+
+
+def test_sharded_s2l_skew_split(mesh8):
+    """A hot join value must drive the S2L giant-line path and stay correct."""
+    triples = generate_triples(150, seed=13, n_predicates=5, n_entities=16)
+    hot = np.stack([np.arange(100, 160, dtype=np.int32),
+                    np.arange(60, dtype=np.int32) % 3 + 500,
+                    np.full(60, 999, dtype=np.int32)], axis=1)
+    triples = np.concatenate([np.asarray(triples, np.int32), hot])
+    stats = {}
+    a = sharded.discover_sharded_s2l(triples, 2, mesh=mesh8, stats=stats)
+    b = small_to_large.discover(triples, 2)
+    assert a.to_rows() == b.to_rows()
+    assert stats["n_giant_lines"] >= 1  # the split path actually fired
+
+
+def test_sharded_s2l_device_invariance():
+    triples = generate_triples(120, seed=17, n_predicates=4, n_entities=12)
+    want = small_to_large.discover(triples, 2).to_rows()
+    for d in (1, 2, 4, 8):
+        got = sharded.discover_sharded_s2l(triples, 2, mesh=make_mesh(d)).to_rows()
+        assert got == want, f"mismatch at {d} devices"
+
+
+def test_global_row_counts_roundtrip(mesh8):
+    """exchange.global_row_counts must equal a host group-count, per row."""
+    import functools
+
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from rdfind_tpu.parallel import exchange
+    from rdfind_tpu.parallel.mesh import AXIS
+
+    rng = np.random.default_rng(0)
+    n = 256  # 32 rows/device
+    keys = rng.integers(0, 37, n).astype(np.int32)
+    valid = rng.random(n) < 0.9
+
+    def step(k, v):
+        c, ovf = exchange.global_row_counts([k], v, AXIS, 64, seed=3)
+        return c, jnp.full(1, ovf, jnp.int32)
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh8, in_specs=(P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS)), check_vma=False))
+    counts, ovf = fn(jnp.asarray(keys), jnp.asarray(valid))
+    assert int(np.asarray(ovf).sum()) == 0
+    want = np.zeros(n, np.int64)
+    uniq, inv = np.unique(keys[valid], return_inverse=True)
+    cnt = np.bincount(inv)
+    lut = dict(zip(uniq.tolist(), cnt.tolist()))
+    for i in range(n):
+        want[i] = lut.get(int(keys[i]), 0) if valid[i] else 0
+    assert np.array_equal(np.asarray(counts), want)
+
+
+def test_capacity_plan_scales_with_load(mesh8):
+    """Planned per-device buffers must track measured loads (~N/D + skew), not
+    the old 'everything lands on one device' worst cases (VERDICT r1 weak #3).
+    """
+    triples = generate_triples(2000, seed=21, n_predicates=8, n_entities=64)
+    # One hot join value so the plan includes real skew.
+    hot = np.stack([np.arange(100, 180, dtype=np.int32),
+                    np.arange(80, dtype=np.int32) % 4 + 900,
+                    np.full(80, 7777, dtype=np.int32)], axis=1)
+    triples = np.concatenate([np.asarray(triples, np.int32), hot])
+    stats = {}
+    a = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    b = allatonce.discover(triples, 2)
+    assert a.to_rows() == b.to_rows()
+
+    caps = stats["planned_caps"]
+    num_dev = 8
+    n = triples.shape[0]
+    t_loc = 1 << (-(-n // num_dev) - 1).bit_length()
+    # The old worst-case formulas (sharded.py r1: cap_b = pow2(D*cap_a),
+    # cap_p = pow2(4*D*cap_a)) for this workload:
+    def pow2(x):
+        return 1 << max(0, int(x) - 1).bit_length() if x > 1 else 1
+    old_cap_a = pow2(9 * t_loc)
+    old_cap_b = pow2(num_dev * old_cap_a)
+    old_cap_p = pow2(4 * num_dev * old_cap_a)
+    # Planned row exchanges must be far below the worst cases...
+    assert caps["exchange_a"] <= old_cap_a // 2
+    assert caps["exchange_b"] <= old_cap_b // 8
+    assert caps["pairs"] <= old_cap_p // 2
+    # ...and within a constant factor of the per-device share of the real load
+    # (pow2 bucketing + 12.5% margin => <= 4x the measured maximum, which is
+    # itself >= share/D of the global row count).
+    assert caps["exchange_b"] <= 4 * (9 * n // num_dev + 80)
