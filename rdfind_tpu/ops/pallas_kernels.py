@@ -10,14 +10,18 @@ rows", for all (dep, ref) pairs at once.
 The jnp path (sketch.contains_matrix) unpacks both sides to full 0/1 planes in
 HBM — a 32x write + read amplification of pure memory traffic — before the MXU
 contraction.  The kernel below never materializes planes: each grid step DMAs a
-packed (TILE, W) uint32 tile into VMEM, unpacks 4 words (128 bits) at a time
-into bf16 registers, and feeds the MXU with (TILE, 128) @ (128, TILE) partial
-contractions, accumulating in f32.  HBM traffic drops to the packed bytes.
+packed (TILE, WK) uint32 tile into VMEM, unpacks it in-register, and feeds the
+MXU with a (TILE, WK*32) contraction, accumulating across word chunks in an f32
+VMEM scratch.  HBM traffic drops to the packed bytes.
 
-Layout notes (see /opt/skills/guides/pallas_guide.md): last dim is 128 lanes;
-the unpack builds each 128-lane group by broadcasting one packed word column
-(TILE, 1) against a (1, 32) shift iota — no in-kernel reshapes or gathers, only
-broadcasts and lane-dim concatenation, which Mosaic handles natively.
+Layout notes (see /opt/skills/guides/pallas_guide.md): Mosaic cannot slice the
+lane dimension at non-128-aligned offsets, so the unpack avoids slicing
+entirely: `pltpu.repeat(x, 32, axis=1)` tiles the packed words 32x along lanes
+(np.tile semantics: lane j holds word j % WK), and the per-lane shift is
+j // WK.  That yields planes in *bit-major* lane order — a fixed permutation of
+the contraction dimension, harmless because both operands unpack identically
+and the dot product is permutation-invariant.  uint32->bf16 needs a two-step
+cast through int32 (Mosaic has no direct lowering, r2 bench failure).
 """
 
 from __future__ import annotations
@@ -32,40 +36,50 @@ from jax.experimental.pallas import tpu as pltpu
 
 TILE_D = 128
 TILE_R = 128
-_WORDS_PER_STEP = 4  # 4 uint32 words = 128 contraction lanes = one full MXU K
+# Words per K grid step: 128 words = 4096 contraction lanes = 1 MB of unpacked
+# bf16 per operand tile in VMEM, well under budget while keeping the MXU fed.
+WK_MAX = 128
 
 
-def _unpack4(ref, w0):
-    """(TILE, 4 words) of a packed uint32 ref -> (TILE, 128) 0/1 bf16 planes."""
-    shifts = jax.lax.broadcasted_iota(jnp.uint32, (1, 32), 1)
-    groups = [
-        ((ref[:, pl.ds(w0 + i, 1)] >> shifts) & jnp.uint32(1)).astype(jnp.bfloat16)
-        for i in range(_WORDS_PER_STEP)
-    ]
-    return jnp.concatenate(groups, axis=1)
+def _unpack_tile(x):
+    """(TILE, WK) packed uint32 -> (TILE, WK*32) 0/1 bf16 planes, bit-major.
+
+    Lane j of the result is bit (j // WK) of word (j % WK).  Only full-tile
+    ops: repeat, iota, shift, compare — no lane slicing (Mosaic requires
+    lane-dim slice offsets to be 128-aligned, which word steps are not).
+    """
+    wk = x.shape[1]
+    rep = pltpu.repeat(x, 32, axis=1)
+    lane = jax.lax.broadcasted_iota(jnp.uint32, rep.shape, 1)
+    shifts = jax.lax.div(lane, jnp.uint32(wk))
+    return ((rep >> shifts) & jnp.uint32(1)).astype(jnp.int32).astype(jnp.bfloat16)
 
 
-def _contains_kernel(s_ref, r_ref, popc_ref, out_ref):
+def _contains_kernel(s_ref, r_ref, popc_ref, out_ref, acc_ref):
     """One (TILE_D, TILE_R) tile of the containment matrix.
 
-    s_ref: (TILE_D, W) packed dep sketches; r_ref: (TILE_R, W) packed ref bit
+    s_ref: (TILE_D, WK) packed dep sketches; r_ref: (TILE_R, WK) packed ref bit
     sets; popc_ref: (1, TILE_R) per-ref set bit counts.  out[d, r] = 1 iff every
     set bit of ref r is set in sketch d, tested as <unpacked s, unpacked r> ==
-    popcount(r) — the same MXU formulation as the jnp path, minus the HBM planes.
+    popcount(r) — the same MXU formulation as the jnp path, minus the HBM
+    planes.  The K grid dim accumulates word chunks into acc_ref.
     """
-    w = s_ref.shape[1]
+    k = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    def body(k, acc):
-        s_b = _unpack4(s_ref, k * _WORDS_PER_STEP)
-        r_b = _unpack4(r_ref, k * _WORDS_PER_STEP)
-        return acc + jax.lax.dot_general(
-            s_b, r_b, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    acc = jax.lax.fori_loop(
-        0, w // _WORDS_PER_STEP, body,
-        jnp.zeros((s_ref.shape[0], r_ref.shape[0]), jnp.float32))
-    out_ref[:] = (acc.astype(jnp.int32) == popc_ref[:]).astype(jnp.uint8)
+    s_b = _unpack_tile(s_ref[:])
+    r_b = _unpack_tile(r_ref[:])
+    acc_ref[:] += jax.lax.dot_general(
+        s_b, r_b, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _finalize():
+        out_ref[:] = (acc_ref[:].astype(jnp.int32) == popc_ref[:]).astype(jnp.uint8)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -75,29 +89,32 @@ def packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
 
     sketch_packed: (D, W) packed dep sketches; ref_packed: (R, W) packed ref bit
     sets; ref_popc: (R,) int32 popcounts of each ref row.  D and R must be
-    multiples of the 128-lane tile; W a multiple of 4.  `interpret=True` runs
-    the kernel in the Pallas interpreter (CPU tests).
+    multiples of the 128-lane tile; W a power-of-two number of words (bits a
+    power of two >= 32, as ops/sketch.py enforces).  `interpret=True` runs the
+    kernel in the Pallas interpreter (CPU tests).
     """
     d, w = sketch_packed.shape
     r = ref_packed.shape[0]
-    if d % TILE_D or r % TILE_R or w % _WORDS_PER_STEP:
+    wk = min(w, WK_MAX)
+    if d % TILE_D or r % TILE_R or w % wk:
         raise ValueError(f"shapes must be tile-aligned, got D={d} R={r} W={w}")
-    grid = (d // TILE_D, r // TILE_R)
+    grid = (d // TILE_D, r // TILE_R, w // wk)
     return pl.pallas_call(
         _contains_kernel,
         out_shape=jax.ShapeDtypeStruct((d, r), jnp.uint8),
-        grid_spec=pl.GridSpec(
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((TILE_D, w), lambda i, j: (i, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((TILE_R, w), lambda i, j: (j, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, TILE_R), lambda i, j: (0, j),
-                             memory_space=pltpu.VMEM),
-            ],
-            out_specs=pl.BlockSpec((TILE_D, TILE_R), lambda i, j: (i, j),
-                                   memory_space=pltpu.VMEM),
-        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE_D, wk), lambda i, j, k: (i, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_R, wk), lambda i, j, k: (j, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE_R), lambda i, j, k: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((TILE_D, TILE_R), lambda i, j, k: (i, j),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((TILE_D, TILE_R), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(sketch_packed, ref_packed, ref_popc.reshape(1, r))

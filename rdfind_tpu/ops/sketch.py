@@ -268,8 +268,8 @@ def kernel_selfcheck(n_rows: int = 1024, n_bits: int = 4096,
                                  num_lines=n_rows, bits=n_bits,
                                  num_hashes=num_hashes)
 
-    def run(be, interpret=False):
-        out = contains_matrix(sketches, ref_ids, ref_valid, bits=n_bits,
+    def run(be, interpret=False, salt=0):
+        out = contains_matrix(sketches, ref_ids + salt, ref_valid, bits=n_bits,
                               num_hashes=num_hashes, backend=be,
                               interpret=interpret)
         return jax.block_until_ready(out)
@@ -281,13 +281,22 @@ def kernel_selfcheck(n_rows: int = 1024, n_bits: int = 4096,
     result = {"parity": parity, "n_rows": n_rows, "bits": n_bits,
               "backend": backend}
     if on_tpu:
+        # Timing methodology: each repeat uses a *different* input (salted ids)
+        # and the loop is drained by one scalar readback at the end — identical
+        # repeated dispatches get streamlined by the runtime and report
+        # physically-impossible per-call times (r2's 0.979x "speedup" artifact).
         for name, be in (("jnp_ms", "jnp"), ("pallas_ms", "pallas")):
-            ts = []
-            for _ in range(repeats):
-                t0 = _time.perf_counter()
-                run(be)
-                ts.append(_time.perf_counter() - t0)
-            result[name] = round(min(ts) * 1e3, 3)
+            int(run(be, salt=-1).sum())  # compile, incl. the drain sum/add ops
+            t0 = _time.perf_counter()
+            acc = None
+            for i in range(repeats):
+                out = contains_matrix(sketches, ref_ids + (i + 1), ref_valid,
+                                      bits=n_bits, num_hashes=num_hashes,
+                                      backend=be)
+                s = out.sum()
+                acc = s if acc is None else acc + s
+            int(acc)  # forces the whole chain to finish
+            result[name] = round((_time.perf_counter() - t0) / repeats * 1e3, 3)
         result["speedup"] = round(result["jnp_ms"] / result["pallas_ms"], 3)
     return result
 

@@ -19,17 +19,19 @@ def random_sketches(rng, n, bits):
     return rng.integers(0, 1 << 32, size=(n, bits // 32), dtype=np.uint32)
 
 
-@pytest.mark.parametrize("seed", [0, 1])
-def test_packed_kernel_matches_jnp(seed):
+@pytest.mark.parametrize("seed,bits", [(0, BITS), (1, BITS), (0, 8192)])
+def test_packed_kernel_matches_jnp(seed, bits):
+    # bits=8192 -> W=256 words > WK_MAX=128, exercising the K-grid accumulation
+    # (scratch init at k==0, finalize at k==nk-1) with nk=2.
     rng = np.random.default_rng(seed)
     d, r = 128, 128
-    sketches = random_sketches(rng, d, BITS)
+    sketches = random_sketches(rng, d, bits)
     ref_ids = jnp.asarray(rng.integers(0, 500, size=r, dtype=np.int32))
     valid = jnp.ones(r, bool)
     want = np.asarray(sketch._contains_matrix_jnp(
-        jnp.asarray(sketches), ref_ids, valid, bits=BITS, num_hashes=K))
+        jnp.asarray(sketches), ref_ids, valid, bits=bits, num_hashes=K))
     got = np.asarray(sketch.contains_matrix(
-        jnp.asarray(sketches), ref_ids, valid, bits=BITS, num_hashes=K,
+        jnp.asarray(sketches), ref_ids, valid, bits=bits, num_hashes=K,
         backend="pallas", interpret=True))
     np.testing.assert_array_equal(got, want)
 
