@@ -38,7 +38,7 @@ import numpy as np
 from .. import conditions as cc
 from .. import oracle
 from ..data import CindTable
-from ..ops import frequency, pairs, segments
+from ..ops import cooc, frequency, pairs, segments
 from ..ops.emission import emit_join_candidates
 
 SENTINEL = segments.SENTINEL
@@ -100,6 +100,85 @@ def _stage_capture_filter(line_val, line_cap, n_rows, min_support):
     keep = valid & (dep_count[caps] >= min_support)
     (out_val, out_cap), n_keep = segments.compact([line_val, line_cap], keep)
     return out_val, out_cap, n_keep, dep_count
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("projections", "use_fc_filter", "use_ars"))
+def _stage_prepare(triples, n_valid, min_support, *, projections, use_fc_filter,
+                   use_ars=False):
+    """Candidate emission + capture interning + dense line ids, for the dense
+    cooc path.  Minimal sort passes, no host row data.
+
+    Unlike the chunked pipeline, this deliberately skips BOTH the
+    (value, capture) row dedupe (the membership scatter's .set(1) dedupes for
+    free) and the frequent-capture row filter: containment forces
+    |ref| >= |dep| = support >= min_support, so infrequent captures can never
+    survive the CIND test on either side — they are just dead columns of M.
+    dep_count and per-line lengths fall out of M as column/row sums
+    (_stage_membership).
+
+    Returns (line_gid, cap_id, valid, n_lines, cap_code, cap_v1, cap_v2,
+    num_caps) at candidate-row capacity.
+    """
+    n = triples.shape[0]
+    valid_t = jnp.arange(n, dtype=jnp.int32) < n_valid
+    freq = (frequency.triple_frequencies(triples, valid_t, min_support,
+                                         find_ar_implied=use_ars)
+            if use_fc_filter else frequency.no_filter(valid_t))
+    cands = emit_join_candidates(triples, freq, projections)
+    (cap_cols, _, cap_id, num_caps) = segments.masked_unique(
+        [cands.code, cands.v1, cands.v2], cands.valid)
+    line_gid, n_lines = segments.masked_dense_ids(cands.join_val, cands.valid)
+    return (line_gid, cap_id, cands.valid, n_lines,
+            cap_cols[0], cap_cols[1], cap_cols[2], num_caps)
+
+
+@functools.partial(jax.jit, static_argnames=("l_pad", "c_pad"))
+def _stage_membership(line_gid, cap_id, valid, min_support, *, l_pad, c_pad):
+    """Membership matrix + the aggregates that fall out of it.
+
+    Returns (m, dep_count, lens): dep_count[c] = distinct join values
+    containing capture c (column sums — exact in f32 below 2^24 lines);
+    lens[l] = frequent captures in line l (matvec against the frequency mask),
+    matching the chunked path's per-line pair accounting.
+    """
+    m = cooc.build_membership(line_gid, cap_id, valid, l_pad=l_pad, c_pad=c_pad)
+    dep_count = jnp.sum(m, axis=0, dtype=jnp.float32).astype(jnp.int32)
+    freq_mask = (dep_count >= min_support).astype(jnp.bfloat16)
+    lens = jax.lax.dot_general(
+        m, freq_mask, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.int32)
+    return m, dep_count, lens
+
+
+# One-shot cooc ceiling: the full (c_pad, c_pad) f32 cooc block.  16384^2 f32
+# = 1 GB — past that, fall back to the tiled host loop.
+SINGLE_SHOT_C = 16384
+
+
+@functools.partial(jax.jit, static_argnames=("l_pad", "c_pad"))
+def _stage_dense_all(line_gid, cap_id, valid, min_support,
+                     cap_code, cap_v1, cap_v2, *, l_pad, c_pad):
+    """Membership + full cooc + CIND test + bit-pack, fused in one dispatch.
+
+    Fusing everything after candidate prep keeps the axon tunnel out of the
+    loop: one dispatch, then one bundled pull of (packed bits, dep_count,
+    lens) — per-dispatch latency was a third of the r2.5 wall clock.
+    """
+    m, dep_count, lens = _stage_membership(line_gid, cap_id, valid, min_support,
+                                           l_pad=l_pad, c_pad=c_pad)
+    packed = cooc.cooc_cind_tile(
+        m, jnp.int32(0), dep_count,
+        _fit_device(cap_code, c_pad), _fit_device(cap_v1, c_pad),
+        _fit_device(cap_v2, c_pad), min_support, tile=c_pad)
+    return packed, dep_count, lens
+
+
+def _fit_device(arr, length: int):
+    """Slice-or-pad a 1-D device array to `length` without a host round trip."""
+    if arr.shape[0] >= length:
+        return jax.lax.slice(arr, (0,), (length,))
+    return jnp.pad(arr, (0, length - arr.shape[0]))
 
 
 @functools.partial(jax.jit, static_argnames=("capacity",))
@@ -255,6 +334,90 @@ def filter_ar_implied_cinds(table: CindTable, mined_rules) -> CindTable:
         table.ref_code, table.ref_v1, table.ref_v2, table.support)))
 
 
+def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
+                    use_ars, clean_implied, stats):
+    """Dense cooc-matmul discovery (ops/cooc.py).  Returns None when the
+    membership matrix exceeds the HBM budget (caller falls back to chunked).
+
+    Host traffic is scalars, per-line lengths, the packed CIND bits, and the
+    final capture-table columns — never the row arrays.
+    """
+    (line_gid, cap_id, cand_valid, n_lines_d, cap_code, cap_v1, cap_v2,
+     num_caps_d) = _stage_prepare(
+        padded, jnp.int32(n), jnp.int32(min_support), projections=projections,
+        use_fc_filter=use_fc_filter, use_ars=use_ars)
+    n_lines, num_caps = (int(x) for x in jax.device_get((n_lines_d, num_caps_d)))
+    if n_lines == 0 or num_caps == 0:
+        return CindTable.empty()
+    plan = cooc.dense_plan(n_lines, num_caps)
+    if plan is None:
+        return None
+    l_pad, c_pad, tile = plan
+
+    if c_pad <= SINGLE_SHOT_C:
+        packed, dep_count, lens = _stage_dense_all(
+            line_gid, cap_id, cand_valid, jnp.int32(min_support),
+            cap_code, cap_v1, cap_v2, l_pad=l_pad, c_pad=c_pad)
+        # One bundled pull: packed CIND bits + per-line lengths + supports +
+        # the capture table columns.
+        (packed_h, lens_h, dep_count_h, code_h, v1_h, v2_h) = jax.device_get(
+            (packed, jax.lax.slice(lens, (0,), (n_lines,)),
+             jax.lax.slice(dep_count, (0,), (num_caps,)),
+             cap_code[:num_caps], cap_v1[:num_caps], cap_v2[:num_caps]))
+        lens_h = lens_h.astype(np.int64)
+        bits = cooc.unpack_cind_bits(packed_h, c_pad)
+        dep_id, ref_id = np.nonzero(bits[:num_caps, :num_caps])
+        support = dep_count_h[dep_id]
+    else:
+        m, dep_count, lens = _stage_membership(
+            line_gid, cap_id, cand_valid, jnp.int32(min_support),
+            l_pad=l_pad, c_pad=c_pad)
+        lens_h = np.asarray(jax.lax.slice(lens, (0,), (n_lines,)), np.int64)
+        dep_id, ref_id, support = cooc.discover_pairs_dense(
+            m, dep_count, _fit_device(cap_code, c_pad),
+            _fit_device(cap_v1, c_pad), _fit_device(cap_v2, c_pad),
+            min_support, num_caps, tile)
+        (code_h, v1_h, v2_h, dep_count_h) = jax.device_get(
+            (cap_code[:num_caps], cap_v1[:num_caps], cap_v2[:num_caps],
+             jax.lax.slice(dep_count, (0,), (num_caps,))))
+
+    total_pairs = int((lens_h * (lens_h - 1)).sum())
+    if stats is not None:
+        # Stat semantics match the chunked backend: n_lines counts lines that
+        # kept >= 1 frequent capture, n_line_rows the deduped (value, capture)
+        # rows (= total memberships, the column-sum total of M).
+        stats.update(n_triples=n, n_frequent_rows=int(lens_h.sum()),
+                     n_line_rows=int(np.asarray(dep_count_h, np.int64).sum()),
+                     n_lines=int((lens_h > 0).sum()), n_captures=num_caps,
+                     total_pairs=total_pairs,
+                     max_line=int(lens_h.max()) if n_lines else 0,
+                     pair_backend="matmul")
+    if dep_id.size == 0:
+        return CindTable.empty()
+    table = CindTable(
+        dep_code=code_h[dep_id].astype(np.int64),
+        dep_v1=v1_h[dep_id].astype(np.int64),
+        dep_v2=v2_h[dep_id].astype(np.int64),
+        ref_code=code_h[ref_id].astype(np.int64),
+        ref_v1=v1_h[ref_id].astype(np.int64),
+        ref_v2=v2_h[ref_id].astype(np.int64),
+        support=support.astype(np.int64),
+    )
+    return _postprocess(table, triples, min_support, use_ars, clean_implied,
+                        stats)
+
+
+def _postprocess(table, triples, min_support, use_ars, clean_implied, stats):
+    if use_ars:
+        rules = frequency.mine_association_rules(triples, min_support)
+        if stats is not None:
+            stats["association_rules"] = rules
+        table = filter_ar_implied_cinds(table, rules)
+    if clean_implied:
+        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+    return table
+
+
 def _chunk_boundaries(pairs_per_line: np.ndarray, budget: int) -> list[int]:
     """Greedy packing of whole lines into chunks of <= budget pairs each.
 
@@ -277,12 +440,18 @@ def discover(triples, min_support: int, projections: str = "spo",
              use_association_rules: bool = False,
              clean_implied: bool = False,
              pair_chunk_budget: int = PAIR_CHUNK_BUDGET,
+             pair_backend: str = "auto",
              stats: dict | None = None) -> CindTable:
     """Discover all CINDs in an (N, 3) int32 triple-id table.
 
     If `stats` is a dict, it is filled with pipeline statistics (candidate rows,
     join lines, total co-occurrence pairs checked, chunks) — the accumulator/counter
     role of the reference's CountItems operators (operators/CountItems.scala:11-33).
+
+    pair_backend selects the quadratic phase: "matmul" runs the dense
+    co-occurrence matmul (ops/cooc.py — the MXU path), "chunked" the legacy
+    sort-and-count chunk loop, "auto" (default) picks matmul whenever the
+    membership matrix fits the HBM budget.
     """
     triples = np.asarray(triples, np.int32)
     n = triples.shape[0]
@@ -294,6 +463,21 @@ def discover(triples, min_support: int, projections: str = "spo",
     padded = jnp.asarray(np.pad(triples, ((0, cap_n - n), (0, 0)),
                                 constant_values=np.iinfo(np.int32).max))
     use_ars = use_association_rules and use_frequent_condition_filter
+
+    if pair_backend in ("auto", "matmul"):
+        # Whether the dense plan fits is only known after candidate prep
+        # (n_lines/num_caps are data-dependent), so a fallback to chunked pays
+        # candidate emission + interning twice.  Callers that know their data
+        # exceeds the membership budget should pass pair_backend="chunked".
+        table = _discover_dense(triples, padded, n, min_support, projections,
+                                use_frequent_condition_filter, use_ars,
+                                clean_implied, stats)
+        if table is not None:
+            return table
+        if pair_backend == "matmul":
+            raise ValueError("pair_backend='matmul' but the dense plan "
+                             "does not fit the HBM budget")
+
     (line_val, line_cap, n_rows, cap_code, cap_v1, cap_v2, num_caps) = \
         _stage_candidates(padded, jnp.int32(n), jnp.int32(min_support),
                           projections=projections,
@@ -329,6 +513,10 @@ def discover(triples, min_support: int, projections: str = "spo",
             max_line=int(line_lens.max()) if line_lens.size else 0)
     if int(pairs_per_line.sum()) == 0:
         return CindTable.empty()
+
+    num_caps = int(num_caps)
+    if stats is not None:
+        stats["pair_backend"] = "chunked"
     pos_h = (np.arange(n_keep, dtype=np.int64)
              - np.repeat(line_start_rows, line_lens)).astype(np.int32)
     len_h = np.repeat(line_lens, line_lens).astype(np.int32)
@@ -377,7 +565,6 @@ def discover(triples, min_support: int, projections: str = "spo",
     dep_id = np.asarray(d_out[:n_out])
     ref_id = np.asarray(r_out[:n_out])
     support = np.asarray(s_out[:n_out])
-    num_caps = int(num_caps)
     cap_code = np.asarray(cap_code[:num_caps])
     cap_v1 = np.asarray(cap_v1[:num_caps])
     cap_v2 = np.asarray(cap_v2[:num_caps])
@@ -390,11 +577,5 @@ def discover(triples, min_support: int, projections: str = "spo",
         ref_v2=cap_v2[ref_id].astype(np.int64),
         support=support.astype(np.int64),
     )
-    if use_ars:
-        rules = frequency.mine_association_rules(triples, min_support)
-        if stats is not None:
-            stats["association_rules"] = rules
-        table = filter_ar_implied_cinds(table, rules)
-    if clean_implied:
-        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
-    return table
+    return _postprocess(table, triples, min_support, use_ars, clean_implied,
+                        stats)

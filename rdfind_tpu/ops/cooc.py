@@ -1,0 +1,147 @@
+"""Dense co-occurrence matmul: the MXU formulation of the quadratic pair phase.
+
+The reference's hot path emits, per join line, every ordered pair of captures
+as a CIND evidence and intersects evidence sets per dependent
+(CreateAllCindCandidates.scala:106-121, IntersectCindCandidates.scala:14-51).
+The count reformulation used across this repo tests `cooc(d, r) == support(d)`
+instead.  This module computes the *entire* cooc matrix as one blocked matmul:
+
+    M    : (lines x captures) 0/1 membership, bf16 in HBM
+    cooc : M^T M, f32 accumulation on the MXU (exact while lines < 2^24)
+
+which replaces the sort-dominated chunked pair pipeline (r2 bench: lexsort over
+every 4M-pair chunk + a host sync per chunk left the MXU idle and lost 13x to
+one Python core).  Skew vanishes by construction — a giant join line is just a
+dense row of M, no splitting or rebalancing required on one chip.
+
+The CIND test, support filter, diagonal and trivially-implied-pair masks all
+run elementwise on (tile x captures) blocks of cooc, and the surviving boolean
+matrix is bit-packed on device so the host pulls C^2/32 bytes, not C^2 ints
+(the axon tunnel makes transfer volume expensive).  The host then just
+np.unpackbits + nonzero to read off CIND pairs.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import conditions as cc
+from . import segments
+
+# Dep-tile rows per cooc block: (DT x C_pad) f32 tile = 16 MB per 1k captures.
+DEFAULT_TILE = 4096
+# Dense membership budget: M is (L_pad x C_pad) bf16 in HBM.  v5e has 16 GB;
+# leave room for the cooc tile, capture tables, and XLA scratch.
+DENSE_M_BUDGET_BYTES = int(os.environ.get("RDFIND_DENSE_M_BUDGET", 6 << 30))
+# f32 accumulation is exact up to 2^24; more lines than that must fall back.
+MAX_LINES_EXACT_F32 = 1 << 24
+
+
+def dense_plan(n_lines: int, num_caps: int, tile: int = DEFAULT_TILE):
+    """Shape plan for the dense path, or None when it does not fit.
+
+    Returns (l_pad, c_pad, tile) with c_pad a multiple of 128 (MXU lanes and
+    32-bit packing) and l_pad a multiple of 8 (f32 sublanes).
+    """
+    if n_lines == 0 or num_caps == 0 or n_lines >= MAX_LINES_EXACT_F32:
+        return None
+    # Power-of-two buckets so compiled programs are reused across datasets
+    # (the repo-wide capacity policy, segments.pow2_capacity).  c_pad a pow2
+    # >= 128 is automatically a multiple of the (pow2) tile, which keeps every
+    # host-loop tile start exact under dynamic_slice's edge clamping.
+    l_pad = max(8, segments.pow2_capacity(n_lines))
+    c_pad = max(128, segments.pow2_capacity(num_caps))
+    tile = min(tile, c_pad)
+    if l_pad * c_pad * 2 > DENSE_M_BUDGET_BYTES:
+        return None
+    return l_pad, c_pad, tile
+
+
+@functools.partial(jax.jit, static_argnames=("l_pad", "c_pad"))
+def build_membership(line_gid, line_cap, valid, *, l_pad: int, c_pad: int):
+    """Scatter (line, capture) rows into the (l_pad, c_pad) 0/1 bf16 matrix."""
+    li = jnp.where(valid, line_gid, l_pad)
+    ci = jnp.where(valid, line_cap, c_pad)
+    m = jnp.zeros((l_pad, c_pad), jnp.bfloat16)
+    return m.at[li, ci].set(jnp.bfloat16(1), mode="drop")
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def cooc_cind_tile(m, dep_lo, dep_count, cap_code, cap_v1, cap_v2,
+                   min_support, *, tile: int):
+    """One (tile x C_pad) block of the CIND matrix, bit-packed along refs.
+
+    m: (l_pad, c_pad) membership; dep_lo: first dep capture id of this tile;
+    dep_count/cap_*: (c_pad,) per-capture support and identity columns.
+    Returns (tile, c_pad // 32) uint32 where bit r of word w in row d means
+    "capture dep_lo+d is CIND-included in capture 32w+r".
+
+    The elementwise masks mirror _stage_merge (models/allatonce.py): support
+    test, min_support, no self-pairs, and the trivially-implied-pair rule of
+    data/Condition.scala:35-43.
+    """
+    c_pad = m.shape[1]
+    m_tile = jax.lax.dynamic_slice(m, (0, dep_lo), (m.shape[0], tile))
+    cooc = jax.lax.dot_general(
+        m_tile, m, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.int32)
+
+    d_idx = dep_lo + jnp.arange(tile, dtype=jnp.int32)
+    d_safe = jnp.clip(d_idx, 0, c_pad - 1)
+    support = dep_count[d_safe][:, None]
+    is_cind = (cooc == support) & (support >= min_support)
+    is_cind &= d_idx[:, None] != jnp.arange(c_pad, dtype=jnp.int32)[None, :]
+
+    d_code = cap_code[d_safe][:, None]
+    d_v1 = cap_v1[d_safe][:, None]
+    d_v2 = cap_v2[d_safe][:, None]
+    r_code = cap_code[None, :]
+    implied = cc.is_subcode(r_code, d_code) & jnp.where(
+        cc.first_subcapture(d_code) == r_code,
+        cap_v1[None, :] == d_v1, cap_v1[None, :] == d_v2)
+    bits = (is_cind & ~implied).astype(jnp.uint32)
+
+    lanes = bits.reshape(tile, c_pad // 32, 32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return (lanes * weights[None, None, :]).sum(axis=2, dtype=jnp.uint32)
+
+
+def unpack_cind_bits(packed: np.ndarray, c_pad: int) -> np.ndarray:
+    """(tile, c_pad//32) uint32 -> (tile, c_pad) 0/1 uint8 on host."""
+    return np.unpackbits(
+        np.ascontiguousarray(packed).view(np.uint8),
+        axis=1, bitorder="little")[:, :c_pad]
+
+
+def discover_pairs_dense(m, dep_count, cap_code, cap_v1, cap_v2, min_support,
+                         num_caps: int, tile: int):
+    """Run the tiled cooc pass; return (dep_id, ref_id, support) numpy arrays.
+
+    m: (l_pad, c_pad) device membership matrix.  Host loops over dep tiles,
+    pulls each packed block, and decodes CIND positions.
+    """
+    c_pad = m.shape[1]
+    dep_count_d = jnp.asarray(dep_count, jnp.int32)
+    code_d = jnp.asarray(cap_code, jnp.int32)
+    v1_d = jnp.asarray(cap_v1, jnp.int32)
+    v2_d = jnp.asarray(cap_v2, jnp.int32)
+    ms = jnp.int32(min_support)
+
+    deps, refs = [], []
+    for lo in range(0, num_caps, tile):
+        packed = cooc_cind_tile(m, jnp.int32(lo), dep_count_d, code_d, v1_d,
+                                v2_d, ms, tile=tile)
+        bits = unpack_cind_bits(np.asarray(packed), c_pad)
+        d_off, r = np.nonzero(bits)
+        keep = (d_off + lo < num_caps) & (r < num_caps)
+        deps.append((d_off[keep] + lo).astype(np.int64))
+        refs.append(r[keep].astype(np.int64))
+    dep_id = np.concatenate(deps) if deps else np.zeros(0, np.int64)
+    ref_id = np.concatenate(refs) if refs else np.zeros(0, np.int64)
+    support = np.asarray(dep_count)[dep_id] if dep_id.size else np.zeros(0, np.int64)
+    return dep_id, ref_id, support

@@ -100,11 +100,31 @@ def masked_unique(cols, valid):
     gid = jnp.cumsum(is_new).astype(jnp.int32) - 1  # valid rows only; garbage run inherits last id
     n_unique = is_new.sum().astype(jnp.int32)
     inverse = jnp.zeros(n, jnp.int32).at[perm].set(gid)
-    # Compact distinct rows to the front, preserving sorted order (stable sort on ~is_new).
-    order = jnp.argsort(~is_new, stable=True)
-    out_cols = [c[order] for c in sorted_cols]
+    # Compact distinct rows to the front, preserving sorted order: scatter each
+    # first-of-run row to its dense id (gid increments in sorted order), which
+    # replaces a full argsort with one scatter.  Rows >= n_unique are SENTINEL.
+    target = jnp.where(is_new, gid, n)
+    out_cols = [jnp.full(n, SENTINEL, c.dtype).at[target].set(c, mode="drop")
+                for c in sorted_cols]
     out_valid = jnp.arange(n, dtype=jnp.int32) < n_unique
     return out_cols, out_valid, inverse, n_unique
+
+
+def masked_dense_ids(col, valid):
+    """Dense ids (0..n_ids-1, in ascending key order) for one key column.
+
+    The light sibling of masked_unique for callers that need only the inverse
+    mapping and the count — skips the compaction argsort and the unique-row
+    columns (one sort pass total).  Invalid rows get a garbage id; mask them.
+    """
+    n = col.shape[0]
+    key = jnp.where(valid, col, SENTINEL)
+    perm = jnp.argsort(key)
+    v_sorted = valid[perm]
+    is_new = run_starts([key[perm]]) & v_sorted
+    gid = jnp.cumsum(is_new).astype(jnp.int32) - 1
+    inverse = jnp.zeros(n, jnp.int32).at[perm].set(gid)
+    return inverse, is_new.sum().astype(jnp.int32)
 
 
 def compact(cols, keep):

@@ -96,12 +96,35 @@ def test_engine_empty_and_tiny():
 def test_engine_chunked_matches_unchunked():
     # Tiny pair budget forces many chunks incl. single-line chunks over budget;
     # the cross-chunk merge must reproduce the one-chunk result exactly.
+    # pair_backend="chunked" pins the legacy pipeline: with the default "auto"
+    # the dense matmul path would short-circuit and pair_chunk_budget would
+    # never be exercised.
     rng = random.Random(9)
     triples = random_triples(rng, 100, 6, 3, 5)
-    a = run_engine(triples, 2, pair_chunk_budget=16)
-    b = run_engine(triples, 2)
+    a = run_engine(triples, 2, pair_backend="chunked", pair_chunk_budget=16)
+    b = run_engine(triples, 2, pair_backend="chunked")
     assert canon(a) == canon(b)
     assert canon(a) == canon(oracle_rows(triples, 2))
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_engine_dense_matches_chunked(seed):
+    # The two quadratic backends must agree exactly (and match the oracle);
+    # this is the only coverage the chunked fallback gets now that "auto"
+    # always picks the dense path at test sizes.
+    rng = random.Random(seed + 40)
+    triples = random_triples(rng, 120, 7, 3, 5)
+    stats_d, stats_c = {}, {}
+    a = run_engine(triples, 2, pair_backend="matmul", stats=stats_d)
+    b = run_engine(triples, 2, pair_backend="chunked", stats=stats_c)
+    assert stats_d["pair_backend"] == "matmul"
+    assert stats_c["pair_backend"] == "chunked"
+    assert canon(a) == canon(b)
+    assert canon(a) == canon(oracle_rows(triples, 2))
+    # The pipeline stats the bench reports must agree across backends too.
+    for key in ("n_lines", "n_line_rows", "n_frequent_rows", "total_pairs",
+                "max_line", "n_captures"):
+        assert stats_d[key] == stats_c[key], key
 
 
 def test_engine_skewed_star():
