@@ -42,6 +42,21 @@ DENSE_M_BUDGET_BYTES = int(os.environ.get("RDFIND_DENSE_M_BUDGET", 6 << 30))
 MAX_LINES_EXACT_F32 = 1 << 24
 
 
+def pack_bool(x):
+    """(R, C) bool/0-1 -> (R, ceil(C/32)) uint32, little bit order per word.
+
+    The single packing implementation shared by every device stage; the host
+    inverse is unpack_cind_bits (np.unpackbits bitorder="little").
+    """
+    r, c = x.shape
+    if c % 32:
+        x = jnp.pad(x, ((0, 0), (0, 32 - c % 32)))
+        c = x.shape[1]
+    lanes = x.astype(jnp.uint32).reshape(r, c // 32, 32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return (lanes * weights[None, None, :]).sum(axis=2, dtype=jnp.uint32)
+
+
 def dense_plan(n_lines: int, num_caps: int, tile: int = DEFAULT_TILE):
     """Shape plan for the dense path, or None when it does not fit.
 
@@ -104,11 +119,7 @@ def cooc_cind_tile(m, dep_lo, dep_count, cap_code, cap_v1, cap_v2,
     implied = cc.is_subcode(r_code, d_code) & jnp.where(
         cc.first_subcapture(d_code) == r_code,
         cap_v1[None, :] == d_v1, cap_v1[None, :] == d_v2)
-    bits = (is_cind & ~implied).astype(jnp.uint32)
-
-    lanes = bits.reshape(tile, c_pad // 32, 32)
-    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
-    return (lanes * weights[None, None, :]).sum(axis=2, dtype=jnp.uint32)
+    return pack_bool(is_cind & ~implied)
 
 
 def unpack_cind_bits(packed: np.ndarray, c_pad: int) -> np.ndarray:

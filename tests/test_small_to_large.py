@@ -102,12 +102,59 @@ def test_s2l_fc_filter_invariant():
 
 def test_s2l_skewed_data_chunked():
     # A hub join value forces many captures into one line; exercise chunking.
+    # pair_backend="chunked" pins the legacy per-level emission (the default
+    # "auto" would take the dense cooc backend and never chunk).
     rng = random.Random(7)
     triples = [("hub", f"p{i % 3}", f"o{i}") for i in range(40)]
     triples += random_triples(rng, 60, 4, 3, 4)
-    got = run_s2l(triples, 2, pair_chunk_budget=1 << 8)
+    got = run_s2l(triples, 2, pair_backend="chunked", pair_chunk_budget=1 << 8)
     want = s2l_raw_oracle(triples, 2)
     assert canon(got) == canon(want)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_s2l_dense_matches_chunked_with_ars(seed):
+    # The dense backend's AR branch (host filter + device K rebuild via
+    # _scatter_pairs) must reproduce the chunked AR path exactly — ARs gate
+    # the 1/1 CINDs that seed 1/2 generation and 2/1 inference.
+    rng = random.Random(seed + 80)
+    triples = random_triples(rng, 120, 4, 3, 3)  # small pools force ARs
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    a = small_to_large.discover(ids, 2, use_association_rules=True,
+                                pair_backend="matmul")
+    b = small_to_large.discover(ids, 2, use_association_rules=True,
+                                pair_backend="chunked")
+    assert canon(set(map(tuple, a.to_rows()))) == canon(set(map(tuple, b.to_rows())))
+
+
+def test_s2l_dense_matches_chunked_tiny():
+    # One triple: the 2/1 and 2/2 levels have zero candidates — both backends
+    # must leave those stat keys unset (not 0 vs missing).
+    ids, _ = intern_triples(np.asarray([("a", "p", "b")], dtype=object))
+    s_d, s_c = {}, {}
+    a = small_to_large.discover(ids, 1, pair_backend="matmul", stats=s_d)
+    b = small_to_large.discover(ids, 1, pair_backend="chunked", stats=s_c)
+    assert canon(set(map(tuple, a.to_rows()))) == canon(set(map(tuple, b.to_rows())))
+    for key in ("pairs_11", "pairs_12", "pairs_21", "pairs_22", "total_pairs"):
+        assert s_d.get(key) == s_c.get(key), key
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_s2l_dense_matches_chunked(seed):
+    # The resident-cooc backend and the per-level emission backend must agree
+    # exactly, including the per-level pair-accounting stats.
+    rng = random.Random(seed + 60)
+    triples = random_triples(rng, 140, 7, 3, 5)
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    s_d, s_c = {}, {}
+    a = small_to_large.discover(ids, 2, pair_backend="matmul", stats=s_d)
+    b = small_to_large.discover(ids, 2, pair_backend="chunked", stats=s_c)
+    assert s_d["pair_backend"] == "matmul"
+    assert s_c["pair_backend"] == "chunked"
+    assert canon(set(map(tuple, a.to_rows()))) == canon(set(map(tuple, b.to_rows())))
+    for key in ("pairs_11", "pairs_12", "pairs_21", "pairs_22", "total_pairs",
+                "n_cinds_11", "n_proper_overlaps"):
+        assert s_d.get(key) == s_c.get(key), key
 
 
 def test_s2l_empty_and_tiny():
