@@ -443,10 +443,18 @@ def _cind_step(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
 # ---------------------------------------------------------------------------
 
 
+# Floors for the per-device block and planned capacities: every workload small
+# enough to land under a floor shares the same compiled shard_map programs —
+# compilation, not compute, dominates small runs (the r2 test suite recompiled
+# the whole pipeline per test workload).
+T_LOC_FLOOR = 256
+CAP_FLOOR = 512
+
+
 def _shard_triples(triples, num_dev):
     """Contiguous per-device split, padded to a shared power-of-two block."""
     n = triples.shape[0]
-    t_loc = segments.pow2_capacity(-(-n // num_dev))
+    t_loc = max(T_LOC_FLOOR, segments.pow2_capacity(-(-n // num_dev)))
     padded = np.full((num_dev * t_loc, 3), np.iinfo(np.int32).max, np.int32)
     n_valid = np.zeros(num_dev, np.int32)
     for dev in range(num_dev):
@@ -458,7 +466,7 @@ def _shard_triples(triples, num_dev):
     return padded, n_valid, t_loc
 
 
-def _headroom(measured: int, floor: int = 64) -> int:
+def _headroom(measured: int, floor: int = CAP_FLOOR) -> int:
     """Measured load -> planned capacity: +12.5% margin, pow2-bucketed (compiled
     programs are reused across runs whose loads land in the same bucket)."""
     measured = int(measured)

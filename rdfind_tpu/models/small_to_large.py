@@ -233,7 +233,7 @@ _pack_bool = cooc_ops.pack_bool
 @jax.jit
 def _lat11(cooc_m, support, u_freq, ms):
     """1/1 level: K = CIND matrix, P = proper-overlap matrix (both unary&freq,
-    off-diagonal).  Returns (K, P, packed K, packed P, |P|)."""
+    off-diagonal).  Returns (K, P, packed K, |P|)."""
     c = cooc_m.shape[0]
     idx = jnp.arange(c, dtype=jnp.int32)
     base = (u_freq[:, None] & u_freq[None, :]
@@ -255,8 +255,8 @@ def _scatter_pairs(dep_idx, ref_idx, valid, template):
 def _lat12(k, m_mat, cooc_m, support, ms, bin_ids, s1, s2, sub_ok, freq_d):
     """1/2 level: candidates K[d,s1[m]] & K[d,s2[m]] plus the trivial-merge
     refinement (GenerateUnaryBinaryCindCandidates.scala:16-41), verified as
-    cooc == support.  Returns (cind12 (c x B), packed, dep-union mask,
-    ref-union mask over capture ids, u_l line stat)."""
+    cooc == support.  Returns (cind12 (c x B), packed, candidate count,
+    u_l line stat)."""
     c = cooc_m.shape[0]
     nb = bin_ids.shape[0]
     ar_b = jnp.arange(nb, dtype=jnp.int32)
@@ -373,7 +373,8 @@ def _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
     # --- 1/1.
     k, p, k_packed, n_prop = _lat11(
         cooc_m, support_d, jnp.asarray(u_freq), ms)
-    stat_add("pairs_11", _union_line_counts(m_mat, jnp.asarray(u_freq)))
+    if stats is not None:
+        stat_add("pairs_11", _union_line_counts(m_mat, jnp.asarray(u_freq)))
     k_packed_h, n_prop_h = jax.device_get((k_packed, n_prop))
     cind11_d, cind11_r = _bits_pairs(k_packed_h, num_caps, num_caps)
     if use_ars:
@@ -535,6 +536,17 @@ def _generate_x2_candidates(dep_cols, ref_code, ref_v1):
     return order[i], mcode, mv1, mv2
 
 
+def _lookup_capture_ids_structured(cap_code, cap_v1, cap_v2, q_code, q_v1, q_v2):
+    """Exact fallback at any value-space size (structured unique; slow)."""
+    table = np.stack([cap_code, cap_v1, cap_v2], axis=1).astype(np.int64)
+    query = np.stack([q_code, q_v1, q_v2], axis=1).astype(np.int64)
+    allr = np.concatenate([table, query])
+    uniq, inv = np.unique(allr, axis=0, return_inverse=True)
+    pos = np.full(len(uniq), -1, np.int64)
+    pos[inv[:len(table)]] = np.arange(len(table))
+    return pos[inv[len(table):]]
+
+
 def _lookup_capture_ids(cap_code, cap_v1, cap_v2, q_code, q_v1, q_v2):
     """Ids of query captures in the canonical capture table; -1 when absent.
 
@@ -549,8 +561,9 @@ def _lookup_capture_ids(cap_code, cap_v1, cap_v2, q_code, q_v1, q_v2):
     q_v2 = np.asarray(q_v2, np.int64)
     uniq = np.unique(np.concatenate([cap_v1, cap_v2, q_v1, q_v2]))
     bits = max(1, int(uniq.size).bit_length())
-    if 6 + 2 * bits > 63:
-        raise ValueError("value space too large to rank-pack capture keys")
+    if 6 + 2 * bits > 63:  # >= ~2^28 distinct values: exact slow path
+        return _lookup_capture_ids_structured(cap_code, cap_v1, cap_v2,
+                                              q_code, q_v1, q_v2)
 
     def key(c, v1, v2):
         r1 = np.searchsorted(uniq, v1).astype(np.int64)
@@ -607,6 +620,10 @@ def discover(triples, min_support: int, projections: str = "spo",
 
     dense = None
     if pair_backend in ("auto", "matmul"):
+        # As in allatonce.discover: whether the dense plan fits is only known
+        # after candidate prep, so a fallback to chunked pays emission +
+        # interning twice.  Pass pair_backend="chunked" when the data is known
+        # to exceed the budget.
         cap_n = segments.pow2_capacity(n)
         padded = jnp.asarray(np.pad(triples, ((0, cap_n - n), (0, 0)),
                                     constant_values=np.iinfo(np.int32).max))

@@ -44,6 +44,7 @@ def test_sharded_synthetic_workload(mesh8):
     assert a.to_rows() == b.to_rows()
 
 
+@pytest.mark.slow
 def test_sharded_device_counts(min_support=2):
     # The result must not depend on the mesh size.
     triples = generate_triples(150, seed=6, n_predicates=6, n_entities=24)
@@ -103,6 +104,7 @@ def test_tiny_input_small_mesh():
         assert got == want
 
 
+@pytest.mark.slow
 def test_skew_split_device_invariance(mesh8):
     rng = random.Random(12)
     ids, _ = intern_triples(
@@ -171,6 +173,7 @@ def test_sharded_s2l_skew_split(mesh8):
     assert stats["n_giant_lines"] >= 1  # the split path actually fired
 
 
+@pytest.mark.slow
 def test_sharded_s2l_device_invariance():
     triples = generate_triples(120, seed=17, n_predicates=4, n_entities=12)
     want = small_to_large.discover(triples, 2).to_rows()
@@ -216,11 +219,13 @@ def test_capacity_plan_scales_with_load(mesh8):
     """Planned per-device buffers must track measured loads (~N/D + skew), not
     the old 'everything lands on one device' worst cases (VERDICT r1 weak #3).
     """
-    triples = generate_triples(2000, seed=21, n_predicates=8, n_entities=64)
+    # Sized to share the floored per-device block (t_loc = T_LOC_FLOOR) with
+    # the rest of the suite, so the pipeline compiles are reused.
+    triples = generate_triples(800, seed=21, n_predicates=8, n_entities=64)
     # One hot join value so the plan includes real skew.
-    hot = np.stack([np.arange(100, 180, dtype=np.int32),
-                    np.arange(80, dtype=np.int32) % 4 + 900,
-                    np.full(80, 7777, dtype=np.int32)], axis=1)
+    hot = np.stack([np.arange(100, 160, dtype=np.int32),
+                    np.arange(60, dtype=np.int32) % 4 + 900,
+                    np.full(60, 7777, dtype=np.int32)], axis=1)
     triples = np.concatenate([np.asarray(triples, np.int32), hot])
     stats = {}
     a = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
@@ -230,7 +235,8 @@ def test_capacity_plan_scales_with_load(mesh8):
     caps = stats["planned_caps"]
     num_dev = 8
     n = triples.shape[0]
-    t_loc = 1 << (-(-n // num_dev) - 1).bit_length()
+    t_loc = max(sharded.T_LOC_FLOOR,
+                1 << (-(-n // num_dev) - 1).bit_length())
     # The old worst-case formulas (sharded.py r1: cap_b = pow2(D*cap_a),
     # cap_p = pow2(4*D*cap_a)) for this workload:
     def pow2(x):
