@@ -48,7 +48,7 @@ from .. import conditions as cc
 from .. import oracle
 from ..data import NO_VALUE, CindTable
 from ..ops import cooc as cooc_ops
-from ..ops import frequency, pairs, segments
+from ..ops import frequency, pairs, segments, sketch
 from . import allatonce
 
 SENTINEL = segments.SENTINEL
@@ -81,20 +81,20 @@ def _stage_pair_counts_masked(line_cap, dep_f, ref_f, pos, length, start_idx, *,
     return d_out, r_out, c_out, n_out
 
 
-def _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats, stat_key):
-    """Global (dep, ref) -> co-occurrence counts for flagged capture pairs.
+def _iter_chunk_pairs(line_val_h, line_cap_h, dep_ok, ref_ok, budget,
+                      stats, stat_key):
+    """Yield per-chunk partial (dep, ref, cnt) host arrays for flagged pairs.
 
-    line_val_h/line_cap_h: host arrays of valid join-line rows sorted by (value,
-    capture id).  dep_ok/ref_ok: per-capture-id participation flags.  Rows flagged
-    for neither side are dropped before the quadratic emission — THE saving of this
-    strategy over AllAtOnce.  Returns merged host arrays (dep, ref, cnt).
+    The shared chunk loop under both the exact merge (_chunked_cooc) and the
+    two-round half-approximate 1/1 evaluation.  Rows flagged for neither side
+    are dropped before the quadratic emission; the stat accounting (pair slots
+    materialized per line) accumulates into stats[stat_key].
     """
     row_keep = dep_ok[line_cap_h] | ref_ok[line_cap_h]
     lv, lc = line_val_h[row_keep], line_cap_h[row_keep]
     n = lv.shape[0]
     if n == 0:
-        z = np.zeros(0, np.int64)
-        return z, z, z
+        return
     dep_f_h = dep_ok[lc]
     ref_f_h = ref_ok[lc]
 
@@ -105,17 +105,16 @@ def _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats, stat_ke
     line_lens = np.diff(np.append(line_start_rows, n)).astype(np.int64)
     pairs_per_line = line_lens * (line_lens - 1)
     if stats is not None:
-        stats[stat_key] = int(pairs_per_line.sum())
-        stats["total_pairs"] = stats.get("total_pairs", 0) + stats[stat_key]
+        stats[stat_key] = stats.get(stat_key, 0) + int(pairs_per_line.sum())
+        stats["total_pairs"] = (stats.get("total_pairs", 0)
+                                + int(pairs_per_line.sum()))
     if int(pairs_per_line.sum()) == 0:
-        z = np.zeros(0, np.int64)
-        return z, z, z
+        return
     pos_h = (np.arange(n, dtype=np.int64)
              - np.repeat(line_start_rows, line_lens)).astype(np.int32)
     len_h = np.repeat(line_lens, line_lens).astype(np.int32)
 
     bounds = allatonce._chunk_boundaries(pairs_per_line, budget)
-    parts_d, parts_r, parts_c = [], [], []
     pad = allatonce._pad_np
     for bi in range(len(bounds) - 1):
         lo_line, hi_line = bounds[bi], bounds[bi + 1]
@@ -138,21 +137,211 @@ def _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats, stat_ke
                 (np.arange(rs, re, dtype=np.int32) - pos_h[rs:re]) - rs, row_cap, 0)),
             capacity=pair_cap)
         n_out = int(n_out)
-        parts_d.append(np.asarray(d)[:n_out])
-        parts_r.append(np.asarray(r)[:n_out])
-        parts_c.append(np.asarray(c)[:n_out])
+        yield (np.asarray(d)[:n_out].astype(np.int64),
+               np.asarray(r)[:n_out].astype(np.int64),
+               np.asarray(c)[:n_out].astype(np.int64))
 
-    if not parts_d:
+
+def _merge_pair_parts(parts):
+    """Exact cross-chunk merge (the reduceGroup side of IntersectCindCandidates)."""
+    if not parts:
         z = np.zeros(0, np.int64)
         return z, z, z
-    d = np.concatenate(parts_d).astype(np.int64)
-    r = np.concatenate(parts_r).astype(np.int64)
-    c = np.concatenate(parts_c).astype(np.int64)
-    # Host merge across chunks (the reduceGroup side of IntersectCindCandidates).
+    d = np.concatenate([p[0] for p in parts])
+    r = np.concatenate([p[1] for p in parts])
+    c = np.concatenate([p[2] for p in parts])
     key = (d << 32) | r
     uniq, inv = np.unique(key, return_inverse=True)
     cnt = np.bincount(inv, weights=c, minlength=len(uniq)).astype(np.int64)
     return (uniq >> 32), (uniq & 0xFFFFFFFF), cnt
+
+
+def _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats, stat_key):
+    """Global (dep, ref) -> co-occurrence counts for flagged capture pairs.
+
+    line_val_h/line_cap_h: host arrays of valid join-line rows sorted by (value,
+    capture id).  dep_ok/ref_ok: per-capture-id participation flags.  Rows flagged
+    for neither side are dropped before the quadratic emission — THE saving of this
+    strategy over AllAtOnce.  Returns merged host arrays (dep, ref, cnt).
+    """
+    return _merge_pair_parts(list(_iter_chunk_pairs(
+        line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats, stat_key)))
+
+
+def _sbf_cap(sbf_bits: int) -> int:
+    """Saturation value of an `sbf_bits`-wide spectral counter (clamped to the
+    count-min implementation maximum) — shared by the upfront guard and the
+    sketch build."""
+    return min((1 << max(1, sbf_bits)) - 1, sketch.MAX_COUNT_MIN_CAP)
+
+
+def _pair_hash32(key64: np.ndarray) -> np.ndarray:
+    """int64 pair keys -> well-mixed non-negative int32 count-min keys."""
+    h = (key64.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)) >> np.uint64(32)
+    return (h & np.uint64(0x7FFFFFFF)).astype(np.int32)
+
+
+def _half_approx_cooc_11(line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats,
+                         min_support, explicit_threshold, sbf_bits, sbf_width):
+    """Two-round half-approximate 1/1 overlap evaluation.
+
+    The memory-bounded analog of the reference's spectral-Bloom round
+    (plan/SmallToLargeTraversalStrategy.scala:178-260 with
+    EvaluateHalfApproximateOverlapSets.scala:33-112): round 1 keeps at most
+    `explicit_threshold` exact (dep, ref) counters per dependent and spills the
+    tail into a count-min sketch (ops/sketch.py — the SpectralBloomFilter
+    analog, `sbf_bits` per counter saturating, `sbf_width` counters).  Each
+    explicit pair is then classified: exact (no sketch contribution), unknown
+    (needs round 2), or infrequent (upper bound < min_support — dropped).
+    Round 2 re-scans the join lines restricted to dependents with any spilled
+    or unknown pair and filters partial rows by the sketch upper bound before
+    the exact merge — bounding the merged-pair volume that the exact
+    evaluation would materialize all at once.
+
+    Output (dep, ref, cnt) contains exactly the pairs with cnt >= min_support,
+    with exact counts: a pair below min_support can be neither a 1/1 CIND
+    (cnt == |dep| >= min_support) nor a proper overlap, so the result is
+    output-equivalent to the exact path for every downstream consumer.
+    Sketch collisions only enlarge round 2, never change the output.
+    """
+    cap = _sbf_cap(sbf_bits)
+    threshold = max(0, int(explicit_threshold))
+
+    # --- Round 1: bounded explicit store + count-min tail.
+    exp_keys = np.zeros(0, np.int64)   # sorted (dep<<32)|ref
+    exp_cnt = np.zeros(0, np.int64)
+    exp_per_dep: dict[int, int] = {}
+    spilled_deps: set[int] = set()
+    cm_table = np.zeros(sbf_width, np.int32)
+    n_spilled = 0
+    def _match_explicit(key):
+        """(hit mask, clamped positions) of `key` in the sorted explicit store."""
+        if len(exp_keys) == 0:
+            return np.zeros(len(key), bool), np.zeros(len(key), np.int64)
+        pos = np.minimum(np.searchsorted(exp_keys, key), len(exp_keys) - 1)
+        return exp_keys[pos] == key, pos
+
+    for d, r, c in _iter_chunk_pairs(line_val_h, line_cap_h, dep_ok, ref_ok,
+                                     budget, stats, "pairs_11"):
+        key = (d << 32) | r
+        hit, pos_c = _match_explicit(key)
+        # Existing explicit entries accumulate exactly (merge semantics of
+        # MultiunionHalfApproximateOverlapCandidates: explicit counts sum).
+        np.add.at(exp_cnt, pos_c[hit], c[hit])
+        # New keys: admit up to the per-dep budget, spill the rest.
+        new_d, new_key, new_c = d[~hit], key[~hit], c[~hit]
+        if new_key.size:
+            order = np.argsort(new_key, kind="stable")
+            new_d, new_key, new_c = new_d[order], new_key[order], new_c[order]
+            rank_in_dep = np.zeros(len(new_d), np.int64)
+            srt_starts = np.empty(len(new_d), bool)
+            srt_starts[0] = True
+            srt_starts[1:] = new_d[1:] != new_d[:-1]
+            run_start_idx = np.flatnonzero(srt_starts)
+            run_len = np.diff(np.append(run_start_idx, len(new_d)))
+            rank_in_dep = (np.arange(len(new_d))
+                           - np.repeat(run_start_idx, run_len))
+            used = np.array([exp_per_dep.get(int(dd), 0)
+                             for dd in new_d[run_start_idx]])
+            budget_left = np.maximum(threshold - used, 0)
+            admit = rank_in_dep < np.repeat(budget_left, run_len)
+            # Admitted: merge into the sorted explicit store.
+            if admit.any():
+                a_key, a_c, a_d = new_key[admit], new_c[admit], new_d[admit]
+                merged = np.concatenate([exp_keys, a_key])
+                order2 = np.argsort(merged, kind="stable")
+                exp_keys = merged[order2]
+                exp_cnt = np.concatenate([exp_cnt, a_c])[order2]
+                for dd, cnt_new in zip(*np.unique(a_d, return_counts=True)):
+                    exp_per_dep[int(dd)] = exp_per_dep.get(int(dd), 0) + int(cnt_new)
+            # Spilled: add to the count-min sketch, mark the dep inexact.
+            spill = ~admit
+            if spill.any():
+                s_key, s_c = new_key[spill], new_c[spill]
+                n_spilled += int(spill.sum())
+                spilled_deps.update(int(x) for x in np.unique(new_d[spill]))
+                kcap = segments.pow2_capacity(len(s_key))
+                t = sketch.count_min_add(
+                    jnp.asarray(allatonce._pad_np(_pair_hash32(s_key), kcap, 0)),
+                    jnp.asarray(allatonce._pad_np(
+                        np.minimum(s_c, cap).astype(np.int32), kcap, 0)),
+                    jnp.arange(kcap) < len(s_key),
+                    bits=sbf_width, num_hashes=sketch.DEFAULT_HASHES, cap=cap)
+                cm_table = sketch.merge_count_min([cm_table, np.asarray(t)],
+                                                  cap=cap)
+
+    if len(exp_keys) == 0 and not spilled_deps:
+        z = np.zeros(0, np.int64)
+        return z, z, z
+
+    # --- Classify explicit pairs (EvaluateHalfApproximateOverlapSets).
+    cm_dev = jnp.asarray(cm_table)
+
+    def cm_query(key64):
+        if key64.size == 0:
+            return np.zeros(0, np.int64)
+        kcap = segments.pow2_capacity(len(key64))
+        q = sketch.count_min_query(
+            cm_dev,
+            jnp.asarray(allatonce._pad_np(_pair_hash32(key64), kcap, 0)),
+            bits=sbf_width, num_hashes=sketch.DEFAULT_HASHES)
+        return np.asarray(q)[:len(key64)].astype(np.int64)
+
+    approx = cm_query(exp_keys)
+    exp_dep = exp_keys >> 32
+    exact_pair = approx == 0
+    frequent_exact = exact_pair & (exp_cnt >= min_support)
+    infrequent = (exp_cnt + approx < min_support)
+    unknown = ~exact_pair & ~infrequent
+
+    # --- Round 2: exact re-evaluation for inexact dependents only.
+    r2_deps = set(spilled_deps)
+    r2_deps.update(int(x) for x in np.unique(exp_dep[unknown]))
+    if r2_deps:
+        dep_ok2 = np.zeros(len(dep_ok), bool)
+        dep_ok2[np.fromiter(r2_deps, np.int64, len(r2_deps))] = True
+        dep_ok2 &= dep_ok
+        parts2 = []
+        n_r2_rows = 0
+        for d, r, c in _iter_chunk_pairs(line_val_h, line_cap_h, dep_ok2,
+                                         ref_ok, budget, stats, "pairs_11"):
+            key = (d << 32) | r
+            # Upper bound = explicit part + sketch part; below min_support the
+            # true total is provably below too -> drop before the merge.
+            hit, pos_c = _match_explicit(key)
+            e_part = (np.where(hit, exp_cnt[pos_c], 0)
+                      if len(exp_cnt) else np.zeros(len(key), np.int64))
+            upper = e_part + cm_query(key)
+            keep = upper >= min_support
+            n_r2_rows += int(keep.sum())
+            if keep.any():
+                parts2.append((d[keep], r[keep], c[keep]))
+        d2, r2, c2 = _merge_pair_parts(parts2)
+        k2 = c2 >= min_support
+        d2, r2, c2 = d2[k2], r2[k2], c2[k2]
+    else:
+        d2 = r2 = c2 = np.zeros(0, np.int64)
+        n_r2_rows = 0
+
+    # --- Assemble: exact round-1 pairs of clean deps + round-2 pairs.
+    # Round 2 recomputed every surviving pair of its dependents from scratch,
+    # so round-1 output keeps only exact-frequent pairs of clean dependents.
+    r2_dep_arr = (np.fromiter(r2_deps, np.int64, len(r2_deps))
+                  if r2_deps else np.zeros(0, np.int64))
+    keep1 = frequent_exact & ~np.isin(exp_dep, r2_dep_arr)
+    d1 = exp_dep[keep1]
+    r1 = exp_keys[keep1] & 0xFFFFFFFF
+    c1 = exp_cnt[keep1]
+    if stats is not None:
+        stats.update(ha_spilled=n_spilled, ha_round2_deps=len(r2_deps),
+                     ha_explicit_pairs=len(exp_keys),
+                     ha_round2_merged_pairs=int(d2.size),
+                     ha_round2_rows=n_r2_rows)
+    d_out = np.concatenate([d1, d2])
+    r_out = np.concatenate([r1, r2])
+    c_out = np.concatenate([c1, c2])
+    order = np.argsort((d_out << 32) | r_out, kind="stable")
+    return d_out[order], r_out[order], c_out[order]
 
 
 # ---------------------------------------------------------------------------
@@ -599,6 +788,9 @@ def discover(triples, min_support: int, projections: str = "spo",
              clean_implied: bool = False,
              pair_chunk_budget: int = allatonce.PAIR_CHUNK_BUDGET,
              pair_backend: str = "auto",
+             explicit_threshold: int = -1,
+             sbf_bits: int = -1,
+             sbf_width: int = 1 << 20,
              stats: dict | None = None) -> CindTable:
     """Discover CINDs level by level (SmallToLargeTraversalStrategy semantics).
 
@@ -609,9 +801,28 @@ def discover(triples, min_support: int, projections: str = "spo",
     pair_backend as in allatonce.discover: "matmul" verifies every level
     against one resident M^T M cooc matrix (_DenseCooc), "chunked" runs the
     per-level masked pair emission, "auto" picks matmul when it fits.
+
+    explicit_threshold != -1 selects the memory-bounded half-approximate 1/1
+    round (the reference's spectral-Bloom mode, gated on the same flag —
+    SmallToLargeTraversalStrategy.scala:322-326): at most that many exact
+    per-dependent counters in round 1, tail in a count-min sketch with
+    `sbf_bits` per counter (--sbf-bytes; default sized to hold min_support)
+    and `sbf_width` counters, exact round 2 only for inexact dependents.
+    Output is identical to the exact path; it implies the chunked backend
+    (the dense backend holds the whole cooc matrix anyway).
     """
     min_support = max(int(min_support), 1)
     use_ars = use_association_rules and use_frequent_condition_filter
+    if explicit_threshold != -1:
+        pair_backend = "chunked"
+    if sbf_bits == -1:
+        # Reference default: enough bits to encode min_support
+        # (SmallToLargeTraversalStrategy.scala:182-186).
+        sbf_bits = min_support.bit_length() + 1
+    if explicit_threshold != -1 and             min((1 << max(1, sbf_bits)) - 1, sketch.MAX_COUNT_MIN_CAP) < min_support:
+        # Reference upfront check (SmallToLargeTraversalStrategy.scala:189-193).
+        raise ValueError(
+            f"sbf_bits={sbf_bits} saturates below min_support {min_support}")
 
     triples = np.asarray(triples, np.int32)
     n = triples.shape[0]
@@ -661,18 +872,26 @@ def discover(triples, min_support: int, projections: str = "spo",
         return _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok,
                              pair_chunk_budget, stats, stat_key)
 
+    cooc_fn_11 = None
+    if explicit_threshold != -1:
+        def cooc_fn_11(dep_ok, ref_ok, stat_key):
+            return _half_approx_cooc_11(
+                line_val_h, line_cap_h, dep_ok, ref_ok, pair_chunk_budget,
+                stats, min_support, explicit_threshold, sbf_bits, sbf_width)
+
     rules = (frequency.mine_association_rules(triples, min_support)
              if use_ars else None)
     if use_ars and stats is not None:
         stats["association_rules"] = rules  # driver --ar-output reuses these
 
     return _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count, num_caps,
-                        min_support, use_ars, rules, clean_implied, stats)
+                        min_support, use_ars, rules, clean_implied, stats,
+                        cooc_fn_11=cooc_fn_11)
 
 
 def _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count, num_caps,
                  min_support, use_ars, rules, clean_implied,
-                 stats) -> CindTable:
+                 stats, cooc_fn_11=None) -> CindTable:
     """The S2L lattice walk, generic over the verification backend.
 
     cooc_fn(dep_ok, ref_ok, stat_key) -> (dep_id, ref_id, count): global merged
@@ -686,7 +905,10 @@ def _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count, num_caps,
     unary = np.asarray(cc.is_unary(cap_code))
 
     # --- Level 1/1: unary-unary overlaps (findFrequentSingleSingleConditionOverlaps).
-    d11, r11, c11cnt = cooc_fn(unary, unary, "pairs_11")
+    # cooc_fn_11 (the half-approximate two-round evaluation) applies to this
+    # level only, as in the reference; its output is pre-filtered to
+    # cnt >= min_support, which is output-neutral here (see its docstring).
+    d11, r11, c11cnt = (cooc_fn_11 or cooc_fn)(unary, unary, "pairs_11")
     # Frequent overlaps only (findFrequentUnaryUnaryOverlapsDirectly's
     # rhs-count filter); lhs frequency is guaranteed by the capture filter.
     freq_ov = c11cnt >= min_support

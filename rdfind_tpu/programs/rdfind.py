@@ -6,8 +6,10 @@ and noted in help:
   --find-frequent-captures  exact capture-support pruning is always on;
   --hash-dictionary/--apply-hash/--hash-*  subsumed by exact string interning;
   --no-bulk-merge/--no-combinable-join  merge is always combiner-style;
-  --sbf-bytes/--explicit-threshold/--balanced-overlap-candidates  approximate-
-      strategy tuning, honored once strategies 2/3 land natively.
+  --balanced-overlap-candidates  balanced 1/1 emission tuning (pending).
+--explicit-threshold/--sbf-bytes select and tune the half-approximate 1/1
+overlap round of the default strategy (models/small_to_large.py), as in the
+reference (SmallToLargeTraversalStrategy.scala:322-326).
 """
 
 from __future__ import annotations
@@ -58,11 +60,16 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument(flag, action="store_true", help=argparse.SUPPRESS)
     for flag, dv in (("--rebalance-strategy", 1), ("--rebalance-split", 1),
                      ("--rebalance-max-load", 10000 * 10000),
-                     ("--merge-window-size", -1), ("--sbf-bytes", -1),
-                     ("--explicit-threshold", -1), ("--hash-bytes", -1),
+                     ("--merge-window-size", -1), ("--hash-bytes", -1),
                      ("--frequent-condition-strategy", 0),
                      ("--find-only-fcs", 0)):
         p.add_argument(flag, type=int, default=dv, help=argparse.SUPPRESS)
+    p.add_argument("--explicit-threshold", type=int, default=-1,
+                   help="half-approximate 1/1 round: max exact per-dependent "
+                        "counters (strategy 1; -1 = exact overlaps)")
+    p.add_argument("--sbf-bytes", type=int, default=-1, dest="sbf_bits",
+                   help="bits per spectral (count-min) counter for the "
+                        "half-approximate round (-1 = sized to support)")
     p.add_argument("--rebalance-threshold", type=float, default=1.0,
                    help=argparse.SUPPRESS)
     p.add_argument("--hash-function", default="MD5", help=argparse.SUPPRESS)
@@ -108,6 +115,8 @@ def main(argv=None) -> int:
         n_devices=args.dop,
         native_ingest=not args.no_native_ingest,
         checkpoint_dir=args.checkpoint_dir,
+        explicit_threshold=args.explicit_threshold,
+        sbf_bits=args.sbf_bits,
     )
     result = driver.run(cfg)
     if not (cfg.output_file or cfg.collect_result):

@@ -52,6 +52,8 @@ class Config:
     n_devices: int = 1  # degree of parallelism (the reference's -dop)
     native_ingest: bool = True  # C++ fused read+parse+intern when applicable
     checkpoint_dir: str | None = None  # stage-boundary checkpoints (resume)
+    explicit_threshold: int = -1  # != -1: half-approximate 1/1 (strategy 1)
+    sbf_bits: int = -1  # count-min counter bits (-1 = sized to min_support)
 
 
 @dataclasses.dataclass
@@ -141,8 +143,21 @@ def _checkpoint_fps(cfg: Config, use_native: bool):
         strategy=cfg.traversal_strategy, projections=cfg.projections,
         use_fis=cfg.use_frequent_item_set, use_ars=cfg.use_association_rules,
         clean_implied=cfg.clean_implied, n_devices=cfg.n_devices)
+    if _half_approx_active(cfg):
+        # Only fingerprint the knobs when they actually reach the strategy —
+        # a no-effect flag must not invalidate an identical-output checkpoint.
+        discover_payload.update(explicit_threshold=cfg.explicit_threshold,
+                                sbf_bits=cfg.sbf_bits)
     return checkpoint.fingerprint(ingest_payload), checkpoint.fingerprint(
         discover_payload)
+
+
+def _half_approx_active(cfg: Config) -> bool:
+    """Whether --explicit-threshold actually selects the half-approximate 1/1
+    round: default strategy, single device (the sharded S2L has no
+    half-approximate mode yet)."""
+    return (cfg.explicit_threshold != -1 and cfg.traversal_strategy == 1
+            and cfg.n_devices == 1)
 
 
 def run(cfg: Config) -> RunResult:
@@ -226,6 +241,10 @@ def run(cfg: Config) -> RunResult:
             # of those fall back to the sharded AllAtOnce with a note.
             mesh = make_mesh(cfg.n_devices)
             strategy = cfg.traversal_strategy
+            if cfg.explicit_threshold != -1:
+                print("note: --explicit-threshold (half-approximate 1/1) is "
+                      "single-device only; the sharded run ignores it",
+                      file=sys.stderr)
             if strategy in (2, 3):
                 print(f"note: traversal strategy {strategy} (approximate) is "
                       "not yet sharded; running the sharded AllAtOnce, which "
@@ -247,11 +266,21 @@ def run(cfg: Config) -> RunResult:
         strategy = STRATEGIES.get(cfg.traversal_strategy)
         if strategy is None:
             raise ValueError(f"unknown traversal strategy {cfg.traversal_strategy}")
+        kwargs = {}
+        if cfg.explicit_threshold != -1:
+            # The half-approximate 1/1 round belongs to the default strategy
+            # (reference gates it on this same flag).
+            if not _half_approx_active(cfg):
+                print("note: --explicit-threshold only affects the "
+                      "small-to-large strategy (1)", file=sys.stderr)
+            else:
+                kwargs = dict(explicit_threshold=cfg.explicit_threshold,
+                              sbf_bits=cfg.sbf_bits)
         return strategy(
             ids, cfg.min_support, projections=cfg.projections,
             use_frequent_condition_filter=cfg.use_frequent_item_set,
             use_association_rules=use_ars,
-            clean_implied=cfg.clean_implied, stats=stats)
+            clean_implied=cfg.clean_implied, stats=stats, **kwargs)
 
     table = None
     if ckpt is not None:

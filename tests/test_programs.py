@@ -52,6 +52,21 @@ def test_rdfind_cli_count_only(fixture_file, capsys):
     assert "Detected" in capsys.readouterr().out
 
 
+def test_rdfind_cli_half_approximate_flags(fixture_file, tmp_path, capsys):
+    # --explicit-threshold/--sbf-bytes select the half-approximate 1/1 round
+    # of the default strategy; output must equal the exact run, and the
+    # half-approximate counters must show the mode actually engaged.
+    out_a = tmp_path / "exact.txt"
+    out_b = tmp_path / "ha.txt"
+    assert rdfind.main([fixture_file, "--support", "2",
+                        "--output", str(out_a)]) == 0
+    assert rdfind.main([fixture_file, "--support", "2",
+                        "--explicit-threshold", "1", "--sbf-bytes", "8",
+                        "--output", str(out_b), "--counters", "1"]) == 0
+    assert out_a.read_text() == out_b.read_text()
+    assert "stat-ha_explicit_pairs" in capsys.readouterr().err
+
+
 def test_rdfind_cli_gz_and_strategy(fixture_file, tmp_path, capsys):
     gz = tmp_path / "people.nt.gz"
     with gzip.open(gz, "wt") as f:

@@ -140,6 +140,38 @@ def test_s2l_dense_matches_chunked_tiny():
 
 
 @pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize("threshold,width", [(0, 1 << 12), (2, 1 << 12),
+                                             (8, 64), (10_000, 1 << 12)])
+def test_s2l_half_approximate_matches_exact(seed, threshold, width):
+    # The two-round spectral evaluation must be output-identical to the exact
+    # path for any explicit threshold (0 = everything spilled, huge = nothing
+    # spilled) and any sketch width (64 counters force heavy collisions, which
+    # may only enlarge round 2 — never change the result).
+    rng = random.Random(seed + 200)
+    triples = random_triples(rng, 140, 7, 3, 5)
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    s_h = {}
+    a = small_to_large.discover(ids, 2, explicit_threshold=threshold,
+                                sbf_width=width, stats=s_h)
+    b = small_to_large.discover(ids, 2, pair_backend="chunked")
+    assert s_h["pair_backend"] == "chunked"
+    assert canon(set(map(tuple, a.to_rows()))) == canon(set(map(tuple, b.to_rows())))
+    if threshold == 0:
+        assert s_h["ha_explicit_pairs"] == 0  # everything spilled
+        assert s_h["ha_round2_deps"] > 0
+    if threshold == 10_000:
+        assert s_h["ha_spilled"] == 0  # nothing spilled; round 2 may still
+        # trigger via sketch-collision upper bounds, but must stay empty here
+        assert s_h["ha_round2_deps"] == 0
+
+
+def test_s2l_half_approximate_sbf_bits_guard():
+    ids, _ = intern_triples(np.asarray([("a", "p", "b")], dtype=object))
+    with pytest.raises(ValueError, match="saturates"):
+        small_to_large.discover(ids, 100, explicit_threshold=2, sbf_bits=3)
+
+
+@pytest.mark.parametrize("seed", range(3))
 def test_s2l_dense_matches_chunked(seed):
     # The resident-cooc backend and the per-level emission backend must agree
     # exactly, including the per-level pair-accounting stats.
