@@ -58,9 +58,9 @@ SENTINEL = segments.SENTINEL
 # Device stage: masked pair counting (the per-level evidence extraction).
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("capacity",))
+@functools.partial(jax.jit, static_argnames=("capacity", "balanced"))
 def _stage_pair_counts_masked(line_cap, dep_f, ref_f, pos, length, start_idx, *,
-                              capacity):
+                              capacity, balanced=False):
     """One chunk of (dep-flagged x ref-flagged) co-occurrence pairs, deduped+counted.
 
     Like allatonce._stage_pair_counts but pairs survive only when the dependent row
@@ -68,7 +68,8 @@ def _stage_pair_counts_masked(line_cap, dep_f, ref_f, pos, length, start_idx, *,
     that replaces the reference's family-specific Create*/Extract* operators.
     """
     row, partner, pair_valid = pairs.emit_pair_indices(pos, length, start_idx,
-                                                       capacity)
+                                                       capacity,
+                                                       balanced=balanced)
     pair_valid = pair_valid & dep_f[row] & ref_f[partner]
     dep = jnp.where(pair_valid, line_cap[row], SENTINEL)
     ref = jnp.where(pair_valid, line_cap[partner], SENTINEL)
@@ -82,7 +83,7 @@ def _stage_pair_counts_masked(line_cap, dep_f, ref_f, pos, length, start_idx, *,
 
 
 def _iter_chunk_pairs(line_val_h, line_cap_h, dep_ok, ref_ok, budget,
-                      stats, stat_key):
+                      stats, stat_key, balanced=False):
     """Yield per-chunk partial (dep, ref, cnt) host arrays for flagged pairs.
 
     The shared chunk loop under both the exact merge (_chunked_cooc) and the
@@ -104,6 +105,8 @@ def _iter_chunk_pairs(line_val_h, line_cap_h, dep_ok, ref_ok, budget,
     line_start_rows = np.flatnonzero(starts)
     line_lens = np.diff(np.append(line_start_rows, n)).astype(np.int64)
     pairs_per_line = line_lens * (line_lens - 1)
+    if balanced:
+        pairs_per_line //= 2  # each unordered pair materializes once
     if stats is not None:
         stats[stat_key] = stats.get(stat_key, 0) + int(pairs_per_line.sum())
         stats["total_pairs"] = (stats.get("total_pairs", 0)
@@ -135,7 +138,7 @@ def _iter_chunk_pairs(line_val_h, line_cap_h, dep_ok, ref_ok, budget,
             jnp.asarray(pad(len_h[rs:re], row_cap, 1)),
             jnp.asarray(pad(
                 (np.arange(rs, re, dtype=np.int32) - pos_h[rs:re]) - rs, row_cap, 0)),
-            capacity=pair_cap)
+            capacity=pair_cap, balanced=balanced)
         n_out = int(n_out)
         yield (np.asarray(d)[:n_out].astype(np.int64),
                np.asarray(r)[:n_out].astype(np.int64),
@@ -156,16 +159,34 @@ def _merge_pair_parts(parts):
     return (uniq >> 32), (uniq & 0xFFFFFFFF), cnt
 
 
-def _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats, stat_key):
+def _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats, stat_key,
+                  balanced=False):
     """Global (dep, ref) -> co-occurrence counts for flagged capture pairs.
 
     line_val_h/line_cap_h: host arrays of valid join-line rows sorted by (value,
     capture id).  dep_ok/ref_ok: per-capture-id participation flags.  Rows flagged
     for neither side are dropped before the quadratic emission — THE saving of this
     strategy over AllAtOnce.  Returns merged host arrays (dep, ref, cnt).
+
+    balanced=True halves the materialized 1/1 emission (each unordered pair
+    once, ops/pairs.py rotation ownership) and symmetrizes the merged counts;
+    only valid when dep_ok == ref_ok (the 1/1 level).
     """
-    return _merge_pair_parts(list(_iter_chunk_pairs(
-        line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats, stat_key)))
+    d, r, c = _merge_pair_parts(list(_iter_chunk_pairs(
+        line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats, stat_key,
+        balanced=balanced)))
+    if not balanced or d.size == 0:
+        return d, r, c
+    # Fold by unordered key (ownership is positional, so a capture pair can be
+    # owned in either direction across lines), then emit both directions.
+    lo = np.minimum(d, r)
+    hi = np.maximum(d, r)
+    ukey = (lo << 32) | hi
+    uniq, inv = np.unique(ukey, return_inverse=True)
+    cnt = np.bincount(inv, weights=c, minlength=len(uniq)).astype(np.int64)
+    ld, lr = uniq >> 32, uniq & 0xFFFFFFFF
+    return (np.concatenate([ld, lr]), np.concatenate([lr, ld]),
+            np.concatenate([cnt, cnt]))
 
 
 def _sbf_cap(sbf_bits: int) -> int:
@@ -791,6 +812,7 @@ def discover(triples, min_support: int, projections: str = "spo",
              explicit_threshold: int = -1,
              sbf_bits: int = -1,
              sbf_width: int = 1 << 20,
+             balanced_11: bool = False,
              stats: dict | None = None) -> CindTable:
     """Discover CINDs level by level (SmallToLargeTraversalStrategy semantics).
 
@@ -810,10 +832,16 @@ def discover(triples, min_support: int, projections: str = "spo",
     and `sbf_width` counters, exact round 2 only for inexact dependents.
     Output is identical to the exact path; it implies the chunked backend
     (the dense backend holds the whole cooc matrix anyway).
+
+    balanced_11 (--balanced-overlap-candidates) halves the chunked backend's
+    materialized 1/1 emission via rotation ownership (each unordered pair
+    once; ops/pairs.py), symmetrizing the merged counts — output-identical.
+    Implies the chunked backend; ignored under the half-approximate round
+    (whose two-round bookkeeping tracks directed ownership separately).
     """
     min_support = max(int(min_support), 1)
     use_ars = use_association_rules and use_frequent_condition_filter
-    if explicit_threshold != -1:
+    if explicit_threshold != -1 or balanced_11:
         pair_backend = "chunked"
     if sbf_bits == -1:
         # Reference default: enough bits to encode min_support
@@ -878,6 +906,11 @@ def discover(triples, min_support: int, projections: str = "spo",
             return _half_approx_cooc_11(
                 line_val_h, line_cap_h, dep_ok, ref_ok, pair_chunk_budget,
                 stats, min_support, explicit_threshold, sbf_bits, sbf_width)
+    elif balanced_11:
+        def cooc_fn_11(dep_ok, ref_ok, stat_key):
+            return _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok,
+                                 pair_chunk_budget, stats, stat_key,
+                                 balanced=True)
 
     rules = (frequency.mine_association_rules(triples, min_support)
              if use_ars else None)

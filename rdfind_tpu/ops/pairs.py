@@ -74,7 +74,8 @@ def line_layout(line_val, n_valid):
     return pos, length, run_start, total_pairs
 
 
-def emit_pair_indices(pos, length, start_idx, capacity: int):
+def emit_pair_indices(pos, length, start_idx, capacity: int,
+                      balanced: bool = False):
     """Row/partner indices of all ordered co-occurrence pairs, statically padded.
 
     Returns (row, partner, pair_valid): gather payload columns at `row` (dependent)
@@ -82,9 +83,22 @@ def emit_pair_indices(pos, length, start_idx, capacity: int):
     garbage (masked by pair_valid).  If total pairs exceed `capacity`, the excess is
     truncated — callers must compare line_layout's total against capacity and
     retry/chunk on overflow.
+
+    balanced=True emits each *unordered* pair exactly once — rotations
+    j <= (L-1)//2 per row, plus (for even L) the antipodal rotation L/2 for the
+    first half of positions.  This is the TPU-rotation form of the reference's
+    ring-distance ownership (AbstractExtractBalancedUnaryUnaryOverlapCandidates
+    .scala:64-120): per line, every element owns ~half its partners, total
+    emission L*(L-1)/2, with even per-element load.  Callers must symmetrize
+    the merged counts (ownership is positional, so the same capture pair can
+    be owned in either direction in different lines).
     """
     n = pos.shape[0]
-    reps = length - 1
+    if balanced:
+        reps = ((length - 1) // 2) + ((length % 2 == 0) & (pos < length // 2))
+        reps = reps.astype(jnp.int32)
+    else:
+        reps = length - 1
     # Saturating prefix sum instead of jnp.repeat's internal cumsum: immune to int32
     # wrap on quadratic totals (see saturating_cumsum).
     cum = saturating_cumsum(reps)

@@ -5,11 +5,15 @@ Flags whose machinery is built-in or obsolete here are accepted for compatibilit
 and noted in help:
   --find-frequent-captures  exact capture-support pruning is always on;
   --hash-dictionary/--apply-hash/--hash-*  subsumed by exact string interning;
-  --no-bulk-merge/--no-combinable-join  merge is always combiner-style;
-  --balanced-overlap-candidates  balanced 1/1 emission tuning (pending).
---explicit-threshold/--sbf-bytes select and tune the half-approximate 1/1
-overlap round of the default strategy (models/small_to_large.py), as in the
-reference (SmallToLargeTraversalStrategy.scala:322-326).
+  --no-bulk-merge/--no-combinable-join  merge is always combiner-style.
+
+Real behavior flags beyond the basics:
+  --explicit-threshold/--sbf-bytes select and tune the half-approximate 1/1
+      overlap round of the default strategy (models/small_to_large.py), as in
+      the reference (SmallToLargeTraversalStrategy.scala:322-326);
+  --balanced-overlap-candidates halves the 1/1 emission via rotation ownership
+      (the reference's ring-distance relation, AbstractExtractBalancedUnary
+      UnaryOverlapCandidates.scala:64-120).
 """
 
 from __future__ import annotations
@@ -55,9 +59,12 @@ def build_parser() -> argparse.ArgumentParser:
     # Accepted-for-compatibility (behavior built-in or pending):
     for flag in ("--find-frequent-captures", "--no-bulk-merge",
                  "--no-combinable-join", "--rebalance-join", "--apply-hash",
-                 "--hash-dictionary", "--balanced-overlap-candidates",
-                 "--only-read-compat"):
+                 "--hash-dictionary", "--only-read-compat"):
         p.add_argument(flag, action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--balanced-overlap-candidates", action="store_true",
+                   dest="balanced_11",
+                   help="halve the 1/1 overlap emission via pair ownership "
+                        "(strategy 1, chunked backend)")
     for flag, dv in (("--rebalance-strategy", 1), ("--rebalance-split", 1),
                      ("--rebalance-max-load", 10000 * 10000),
                      ("--merge-window-size", -1), ("--hash-bytes", -1),
@@ -117,6 +124,7 @@ def main(argv=None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         explicit_threshold=args.explicit_threshold,
         sbf_bits=args.sbf_bits,
+        balanced_11=args.balanced_11,
     )
     result = driver.run(cfg)
     if not (cfg.output_file or cfg.collect_result):

@@ -54,6 +54,7 @@ class Config:
     checkpoint_dir: str | None = None  # stage-boundary checkpoints (resume)
     explicit_threshold: int = -1  # != -1: half-approximate 1/1 (strategy 1)
     sbf_bits: int = -1  # count-min counter bits (-1 = sized to min_support)
+    balanced_11: bool = False  # halve 1/1 emission via pair ownership
 
 
 @dataclasses.dataclass
@@ -148,6 +149,7 @@ def _checkpoint_fps(cfg: Config, use_native: bool):
         # a no-effect flag must not invalidate an identical-output checkpoint.
         discover_payload.update(explicit_threshold=cfg.explicit_threshold,
                                 sbf_bits=cfg.sbf_bits)
+    # balanced_11 is output-neutral, so it never enters the fingerprint.
     return checkpoint.fingerprint(ingest_payload), checkpoint.fingerprint(
         discover_payload)
 
@@ -245,6 +247,9 @@ def run(cfg: Config) -> RunResult:
                 print("note: --explicit-threshold (half-approximate 1/1) is "
                       "single-device only; the sharded run ignores it",
                       file=sys.stderr)
+            if cfg.balanced_11:
+                print("note: --balanced-overlap-candidates is single-device "
+                      "only; the sharded run ignores it", file=sys.stderr)
             if strategy in (2, 3):
                 print(f"note: traversal strategy {strategy} (approximate) is "
                       "not yet sharded; running the sharded AllAtOnce, which "
@@ -276,6 +281,12 @@ def run(cfg: Config) -> RunResult:
             else:
                 kwargs = dict(explicit_threshold=cfg.explicit_threshold,
                               sbf_bits=cfg.sbf_bits)
+        if cfg.balanced_11:
+            if cfg.traversal_strategy != 1:
+                print("note: --balanced-overlap-candidates only affects the "
+                      "small-to-large strategy (1)", file=sys.stderr)
+            else:
+                kwargs["balanced_11"] = True
         return strategy(
             ids, cfg.min_support, projections=cfg.projections,
             use_frequent_condition_filter=cfg.use_frequent_item_set,

@@ -165,6 +165,32 @@ def test_s2l_half_approximate_matches_exact(seed, threshold, width):
         assert s_h["ha_round2_deps"] == 0
 
 
+@pytest.mark.parametrize("seed", range(3))
+def test_s2l_balanced_11_matches_exact(seed):
+    # Rotation-ownership emission must produce identical output with exactly
+    # half the materialized 1/1 pair slots (the reference's ring-distance
+    # balancing, AbstractExtractBalancedUnaryUnaryOverlapCandidates).
+    rng = random.Random(seed + 300)
+    triples = random_triples(rng, 140, 7, 3, 5)
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    s_b, s_c = {}, {}
+    a = small_to_large.discover(ids, 2, balanced_11=True, stats=s_b)
+    b = small_to_large.discover(ids, 2, pair_backend="chunked", stats=s_c)
+    assert canon(set(map(tuple, a.to_rows()))) == canon(set(map(tuple, b.to_rows())))
+    assert s_b["pairs_11"] * 2 == s_c["pairs_11"]
+
+
+def test_s2l_balanced_11_skewed_chunked():
+    # A hub line exceeding pair_chunk_budget gets its own oversized chunk
+    # (chunking is whole-line-granular); ownership must stay correct there.
+    triples = [("hub", f"p{i % 3}", f"o{i}") for i in range(40)]
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    a = small_to_large.discover(ids, 2, balanced_11=True,
+                                pair_chunk_budget=1 << 8)
+    b = small_to_large.discover(ids, 2, pair_backend="chunked")
+    assert set(map(tuple, a.to_rows())) == set(map(tuple, b.to_rows()))
+
+
 def test_s2l_half_approximate_sbf_bits_guard():
     ids, _ = intern_triples(np.asarray([("a", "p", "b")], dtype=object))
     with pytest.raises(ValueError, match="saturates"):
