@@ -67,6 +67,7 @@ needed, because every grouping is a hash-bucketed sort on the owning device.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -756,6 +757,228 @@ class _ShardedCooc:
                                 r_v2.astype(np.int64))
         ok = (d >= 0) & (r >= 0)
         return d[ok], r[ok], cnt[ok].astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Sharded approximate strategies (2: ApproximateAllAtOnce, 3: LateBB): the
+# sketch matrix is built and tiled over the mesh — each device ANDs partial
+# dependent sketches from its local lines (cross-device AND = pmin over 0/1
+# planes), then runs the containment matmul for its own block of dependent
+# rows against the replicated ref side (no cross-device reduction; the
+# distributed-by-construction contract of plan/TraversalStrategy.scala:28-33).
+# ---------------------------------------------------------------------------
+
+
+def _sketch_step_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, n_caps, *,
+                        c_pad, bits, num_hashes):
+    from ..ops import sketch
+
+    n = jv.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
+    cap_idx = segments.masked_table_index([tc, tv1, tv2], n_caps[0],
+                                          [code, v1, v2], valid)
+    ok = valid & (cap_idx >= 0)
+    jv_key = jnp.where(valid, jv, SENTINEL)
+    starts = segments.run_starts([jv_key]) & valid
+    line_gid = jnp.cumsum(starts).astype(jnp.int32) - 1
+    blooms = sketch.build_line_blooms(line_gid, jnp.maximum(cap_idx, 0), ok,
+                                      num_lines=n, bits=bits,
+                                      num_hashes=num_hashes)
+    partial = sketch.intersect_dep_sketches(
+        jnp.maximum(cap_idx, 0), blooms[jnp.clip(line_gid, 0, n - 1)], ok,
+        num_caps=c_pad, bits=bits)
+    planes = jax.lax.pmin(sketch.unpack_planes(partial), AXIS)
+
+    num_dev = jax.lax.axis_size(AXIS)
+    block = c_pad // num_dev
+    dep_lo = jax.lax.axis_index(AXIS) * block
+    own = jax.lax.dynamic_slice(sketch.pack_planes(planes), (dep_lo, 0),
+                                (block, bits // 32))
+    ref_ids = jnp.arange(c_pad, dtype=jnp.int32)
+    ref_ok = ref_ids < n_caps[0]
+    # Dispatcher call: the packed Pallas kernel on TPU, jnp planes elsewhere
+    # (pallas_call composes with shard_map; CPU-mesh tests take the jnp path).
+    cand = sketch.contains_matrix(own, ref_ids, ref_ok, bits=bits,
+                                  num_hashes=num_hashes)
+    cand &= (dep_lo + jnp.arange(block, dtype=jnp.int32))[:, None] != \
+        ref_ids[None, :]
+    from ..ops import cooc as cooc_ops
+    return cooc_ops.pack_bool(cand)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "c_pad", "bits", "num_hashes"))
+def _sketch_step(jv, code, v1, v2, n_rows, tc, tv1, tv2, n_caps, *, mesh,
+                 c_pad, bits, num_hashes):
+    fn = functools.partial(_sketch_step_device, c_pad=c_pad, bits=bits,
+                           num_hashes=num_hashes)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(AXIS),) * 5 + (P(),) * 4,
+        out_specs=P(AXIS),
+        check_vma=False,
+    )(jv, code, v1, v2, n_rows, tc, tv1, tv2, n_caps)
+
+
+# The sketch stage materializes (rows_per_device x bits) 0/1 planes in one
+# jitted program (no line-aligned chunking inside shard_map yet — the
+# single-chip path chunks on host, approximate._build_sketches).  Guard the
+# transient instead of OOMing mid-collective.
+SKETCH_PLANES_BUDGET = int(os.environ.get("RDFIND_SKETCH_PLANES_BUDGET",
+                                          4 << 30))
+
+
+def _sharded_sketch_candidates(pipe, cap_table, bits, num_hashes, stats):
+    """(cand_dep, cand_ref) global capture-id pairs from the mesh-tiled
+    containment matmul over the replicated frequent-capture table."""
+    from ..ops import cooc as cooc_ops
+
+    rows_cap = pipe.lines[0].shape[0] // pipe.num_dev
+    if rows_cap * bits > SKETCH_PLANES_BUDGET:
+        raise ValueError(
+            f"sharded sketch stage would materialize ~{rows_cap * bits >> 30} "
+            f"GiB of line-bloom planes per device; lower sketch_bits or use "
+            f"strategy 0/1 (RDFIND_SKETCH_PLANES_BUDGET overrides)")
+
+    cap_code, cap_v1, cap_v2, _ = cap_table
+    num_caps = cap_code.shape[0]
+    num_dev = pipe.num_dev
+    # Pad to a multiple of the device count so the per-device dep blocks tile
+    # the table exactly (pow2 bucket first for compile reuse).
+    c_pad = segments.pow2_capacity(num_caps)
+    c_pad = num_dev * (-(-c_pad // num_dev))
+    pad = lambda a: np.concatenate(
+        [a.astype(np.int32), np.full(c_pad - num_caps, SENTINEL, np.int32)])
+    packed = _sketch_step(
+        *pipe.lines, pipe.n_rows,
+        jnp.asarray(pad(cap_code)), jnp.asarray(pad(cap_v1)),
+        jnp.asarray(pad(cap_v2)), jnp.full(1, num_caps, jnp.int32),
+        mesh=pipe.mesh, c_pad=c_pad, bits=bits, num_hashes=num_hashes)
+    bits_h = cooc_ops.unpack_cind_bits(np.asarray(packed), c_pad)
+    d, r = np.nonzero(bits_h[:num_caps, :num_caps])
+    if stats is not None:
+        stats["n_sketch_candidates"] = int(d.size)
+    return d.astype(np.int64), r.astype(np.int64)
+
+
+def _sharded_prep_approx(triples, min_support, mesh, projections, use_fis,
+                         use_ars, max_retries, sketch_bits, sketch_hashes,
+                         stats):
+    """Shared setup for sharded strategies 2/3: pipeline, frequent-capture
+    table, sketch candidates, and the sharded verification backend."""
+    pipe = _Pipeline(mesh, triples, min_support, projections, use_fis, use_ars,
+                     max_retries, stats)
+    cap_code, cap_v1, cap_v2, dep_count = pipe.capture_table()
+    freq_cap = dep_count >= min_support
+    cap_table = tuple(a[freq_cap] for a in (cap_code, cap_v1, cap_v2,
+                                            dep_count))
+    if cap_table[0].shape[0] == 0:
+        return None
+    if stats is not None:
+        stats.update(n_triples=triples.shape[0],
+                     n_captures=int(cap_table[0].shape[0]), total_pairs=0)
+    cand_dep, cand_ref = _sharded_sketch_candidates(
+        pipe, cap_table, sketch_bits, sketch_hashes, stats)
+    backend = _ShardedCooc(pipe, cap_table)
+    return cap_table, cand_dep, cand_ref, backend
+
+
+def _finish_table(cap_table, d, r, sup, triples, min_support, use_ars,
+                  clean_implied, stats):
+    from . import allatonce
+
+    cap_code, cap_v1, cap_v2, _ = cap_table
+    table = CindTable(
+        dep_code=cap_code[d], dep_v1=cap_v1[d], dep_v2=cap_v2[d],
+        ref_code=cap_code[r], ref_v1=cap_v1[r], ref_v2=cap_v2[r],
+        support=sup)
+    if use_ars:
+        rules = frequency.mine_association_rules(triples, min_support)
+        if stats is not None:
+            stats["association_rules"] = rules
+        table = allatonce.filter_ar_implied_cinds(table, rules)
+    if clean_implied:
+        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+    return table
+
+
+def discover_sharded_approx(triples, min_support: int, mesh=None,
+                            projections: str = "spo", use_fis: bool = False,
+                            use_ars: bool = False, clean_implied: bool = False,
+                            max_retries: int = 4, sketch_bits: int = 2048,
+                            sketch_hashes: int = 4,
+                            stats: dict | None = None) -> CindTable:
+    """Sharded ApproximateAllAtOnce (strategy 2): mesh-tiled sketch containment
+    for candidates, exact sharded counting for verification.  Output is
+    identical to models.approximate.discover (= raw AllAtOnce)."""
+    from . import small_to_large
+
+    if mesh is None:
+        mesh = make_mesh()
+    triples = np.asarray(triples, np.int32)
+    if triples.shape[0] == 0 or not any(ch in projections for ch in "spo"):
+        return CindTable.empty()
+    min_support = max(int(min_support), 1)
+    use_ars = use_ars and use_fis
+
+    prep = _sharded_prep_approx(triples, min_support, mesh, projections,
+                                use_fis, use_ars, max_retries, sketch_bits,
+                                sketch_hashes, stats)
+    if prep is None:
+        return CindTable.empty()
+    cap_table, cand_dep, cand_ref, backend = prep
+    cap_code, cap_v1, cap_v2, dep_count = cap_table
+    d, r, sup = small_to_large._verify_level(
+        backend.cooc, cand_dep, cand_ref, cap_code.shape[0], dep_count,
+        cap_code, cap_v1, cap_v2, min_support, "pairs_verify")
+    return _finish_table(cap_table, d, r, sup, triples, min_support, use_ars,
+                         clean_implied, stats)
+
+
+def discover_sharded_late_bb(triples, min_support: int, mesh=None,
+                             projections: str = "spo", use_fis: bool = False,
+                             use_ars: bool = False, clean_implied: bool = False,
+                             max_retries: int = 4, sketch_bits: int = 2048,
+                             sketch_hashes: int = 4,
+                             stats: dict | None = None) -> CindTable:
+    """Sharded LateBB (strategy 3): one mesh-tiled sketch pass, then the
+    unary-dependent round and the 1/x-pruned binary round verify on the mesh.
+    Output is identical to models.late_bb.discover."""
+    from . import small_to_large
+
+    if mesh is None:
+        mesh = make_mesh()
+    triples = np.asarray(triples, np.int32)
+    if triples.shape[0] == 0 or not any(ch in projections for ch in "spo"):
+        return CindTable.empty()
+    min_support = max(int(min_support), 1)
+    use_ars = use_ars and use_fis
+
+    prep = _sharded_prep_approx(triples, min_support, mesh, projections,
+                                use_fis, use_ars, max_retries, sketch_bits,
+                                sketch_hashes, stats)
+    if prep is None:
+        return CindTable.empty()
+    cap_table, cand_dep, cand_ref, backend = prep
+    cap_code, cap_v1, cap_v2, dep_count = cap_table
+    num_caps = cap_code.shape[0]
+    dep_is_unary = np.asarray(cc.is_unary(cap_code))[cand_dep]
+
+    d1, r1, sup1 = small_to_large._verify_level(
+        backend.cooc, cand_dep[dep_is_unary], cand_ref[dep_is_unary], num_caps,
+        dep_count, cap_code, cap_v1, cap_v2, min_support, "pairs_round1")
+    c2_dep, c2_ref = cand_dep[~dep_is_unary], cand_ref[~dep_is_unary]
+    keep = small_to_large._prune_22_vs_12(c2_dep, c2_ref, d1, r1,
+                                          cap_code, cap_v1, cap_v2)
+    d2, r2, sup2 = small_to_large._verify_level(
+        backend.cooc, c2_dep[keep], c2_ref[keep], num_caps, dep_count,
+        cap_code, cap_v1, cap_v2, min_support, "pairs_round2")
+    if stats is not None:
+        stats.update(n_round1_cinds=len(d1), n_round2_cinds=len(d2))
+    return _finish_table(
+        cap_table, np.concatenate([d1, d2]), np.concatenate([r1, r2]),
+        np.concatenate([sup1, sup2]), triples, min_support, use_ars,
+        clean_implied, stats)
 
 
 def discover_sharded_s2l(triples, min_support: int, mesh=None,

@@ -110,6 +110,35 @@ def masked_unique(cols, valid):
     return out_cols, out_valid, inverse, n_unique
 
 
+def masked_table_index(table_cols, n_table, query_cols, query_valid):
+    """For each query row, the index of its key in a sorted table, else -1.
+
+    table_cols: valid-prefix columns, rows [0, n_table) sorted ascending and
+    distinct (masked_unique output shape).  A sort-merge join: table rows tag 0
+    sort before equal-key query rows, so each run's first element carries the
+    table index for the whole run.  Invalid/absent queries get -1.
+    """
+    n_t = table_cols[0].shape[0]
+    n_q = query_cols[0].shape[0]
+    t_valid = jnp.arange(n_t, dtype=jnp.int32) < n_table
+    cols = [jnp.concatenate([jnp.where(t_valid, tc, SENTINEL),
+                             jnp.where(query_valid, qc, SENTINEL)])
+            for tc, qc in zip(table_cols, query_cols)]
+    # Tags: valid table 0 < query 1 < invalid table 2, so the SENTINEL garbage
+    # run can never begin with a padded table row (which would donate a bogus
+    # index to invalid queries).
+    tag = jnp.concatenate([jnp.where(t_valid, 0, 2).astype(jnp.int32),
+                           jnp.ones(n_q, jnp.int32)])
+    perm = lexsort(cols + [tag])
+    starts = run_starts([c[perm] for c in cols])
+    idx = jnp.arange(n_t + n_q, dtype=jnp.int32)
+    start_pos = jax.lax.cummax(jnp.where(starts, idx, 0))
+    first_orig = perm[start_pos]
+    run_idx = jnp.where(first_orig < n_t, first_orig, -1)
+    out = jnp.zeros(n_t + n_q, jnp.int32).at[perm].set(run_idx)
+    return jnp.where(query_valid, out[n_t:], -1)
+
+
 def masked_dense_ids(col, valid):
     """Dense ids (0..n_ids-1, in ascending key order) for one key column.
 

@@ -236,11 +236,12 @@ def run(cfg: Config) -> RunResult:
 
     def discover():
         if cfg.n_devices > 1:
-            # Distributed strategy dispatch: 0 = sharded AllAtOnce, 1 = sharded
-            # SmallToLarge (the default, like the reference's distributed-by-
-            # construction plans).  The approximate strategies (2, 3) produce
-            # the same exact output as AllAtOnce by design, so multi-device runs
-            # of those fall back to the sharded AllAtOnce with a note.
+            # Distributed strategy dispatch, all four ids native on the mesh
+            # (the reference's distributed-by-construction contract,
+            # plan/TraversalStrategy.scala:28-33): 0 = sharded AllAtOnce,
+            # 1 = sharded SmallToLarge (default), 2 = sharded Approximate
+            # AllAtOnce, 3 = sharded LateBB (raw output drops 1/x-implied 2/x
+            # CINDs, like its single-device form).
             mesh = make_mesh(cfg.n_devices)
             strategy = cfg.traversal_strategy
             if cfg.explicit_threshold != -1:
@@ -250,11 +251,18 @@ def run(cfg: Config) -> RunResult:
             if cfg.balanced_11:
                 print("note: --balanced-overlap-candidates is single-device "
                       "only; the sharded run ignores it", file=sys.stderr)
-            if strategy in (2, 3):
-                print(f"note: traversal strategy {strategy} (approximate) is "
-                      "not yet sharded; running the sharded AllAtOnce, which "
-                      "produces the identical exact output", file=sys.stderr)
-                strategy = 0
+            if strategy == 2:
+                return sharded.discover_sharded_approx(
+                    ids, cfg.min_support, mesh=mesh,
+                    projections=cfg.projections,
+                    use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
+                    clean_implied=cfg.clean_implied, stats=stats)
+            if strategy == 3:
+                return sharded.discover_sharded_late_bb(
+                    ids, cfg.min_support, mesh=mesh,
+                    projections=cfg.projections,
+                    use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
+                    clean_implied=cfg.clean_implied, stats=stats)
             if strategy == 1:
                 return sharded.discover_sharded_s2l(
                     ids, cfg.min_support, mesh=mesh,

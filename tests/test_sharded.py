@@ -252,3 +252,44 @@ def test_capacity_plan_scales_with_load(mesh8):
     # (pow2 bucketing + 12.5% margin => <= 4x the measured maximum, which is
     # itself >= share/D of the global row count).
     assert caps["exchange_b"] <= 4 * (9 * n // num_dev + 80)
+
+
+# ---------------------------------------------------------------------------
+# Sharded approximate strategies (2: ApproximateAllAtOnce, 3: LateBB).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(2))
+@pytest.mark.parametrize("min_support", [1, 3])
+def test_sharded_approx_matches_single_chip(mesh8, seed, min_support):
+    from rdfind_tpu.models import approximate
+    rng = random.Random(seed + 500)
+    ids, _ = intern_triples(
+        np.asarray(random_triples(rng, 120, 6, 3, 5), dtype=object))
+    stats = {}
+    a = sharded.discover_sharded_approx(ids, min_support, mesh=mesh8,
+                                        stats=stats)
+    b = approximate.discover(ids, min_support)
+    assert a.to_rows() == b.to_rows()
+    assert stats["n_sketch_candidates"] > 0
+
+
+def test_sharded_late_bb_matches_single_chip(mesh8):
+    from rdfind_tpu.models import late_bb
+    rng = random.Random(77)
+    ids, _ = intern_triples(
+        np.asarray(random_triples(rng, 120, 6, 3, 5), dtype=object))
+    a = sharded.discover_sharded_late_bb(ids, 2, mesh=mesh8)
+    b = late_bb.discover(ids, 2)
+    assert a.to_rows() == b.to_rows()
+
+
+def test_sharded_approx_flags(mesh8):
+    from rdfind_tpu.models import approximate
+    rng = random.Random(78)
+    ids, _ = intern_triples(
+        np.asarray(random_triples(rng, 100, 5, 3, 4), dtype=object))
+    a = sharded.discover_sharded_approx(ids, 2, mesh=mesh8, use_fis=True,
+                                        use_ars=True, clean_implied=True)
+    b = approximate.discover(ids, 2, use_frequent_condition_filter=True,
+                             use_association_rules=True, clean_implied=True)
+    assert a.to_rows() == b.to_rows()
