@@ -1,0 +1,117 @@
+"""Benchmark matrix: BASELINE.md configs 1-2 across traversal strategies.
+
+Fills the BASELINE.md measurement table (the reference's always-reporting
+measurement machinery, AbstractFlinkProgram.java:65-77,175-182): one row per
+(config, strategy) with wall-clock, pairs/s/chip, and CIND counts.
+
+  Config 1: LUBM-1-shaped synthetic (~100k triples), support >= 10.
+            "Unary CINDs only" is reported as the 1/1-family slice of the
+            full output (the pipeline has no unary-only mode, like the
+            reference, which always mines all four families).
+  Config 2: DBpedia-person-slice-shaped synthetic (~2M triples),
+            unary+binary, support >= 100.
+
+Usage: python bench_matrix.py [--configs 1,2] [--strategies 0,1,2]
+Prints one JSON line per row, then a summary table on stderr.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _cind_family_counts(table):
+    from rdfind_tpu import conditions as cc
+
+    dep = np.asarray(table.dep_code)
+    ref = np.asarray(table.ref_code)
+    dep_u = cc.is_unary(dep)
+    ref_u = cc.is_unary(ref)
+    return {
+        "11": int((dep_u & ref_u).sum()),
+        "12": int((dep_u & ~ref_u).sum()),
+        "21": int((~dep_u & ref_u).sum()),
+        "22": int((~dep_u & ~ref_u).sum()),
+    }
+
+
+CONFIGS = {
+    1: dict(n=100_000, min_support=10, seed=101,
+            synth=dict(n_predicates=18, n_entities=17_000),
+            label="LUBM-1-shaped 100k, support>=10"),
+    2: dict(n=2_000_000, min_support=100, seed=202,
+            synth=dict(n_predicates=64, n_entities=250_000),
+            label="person-slice-shaped 2M, unary+binary, support>=100"),
+}
+
+
+def run_one(config_id: int, strategy: int) -> dict:
+    from rdfind_tpu.models import allatonce, approximate, small_to_large
+    from rdfind_tpu.utils.synth import generate_triples
+
+    spec = CONFIGS[config_id]
+    triples = generate_triples(spec["n"], seed=spec["seed"], **spec["synth"])
+    discover = {0: allatonce.discover, 1: small_to_large.discover,
+                2: approximate.discover}[strategy]
+
+    stats: dict = {}
+    discover(triples, spec["min_support"], stats=stats)  # warm-up (compile)
+    stats.clear()
+    t0 = time.perf_counter()
+    table = discover(triples, spec["min_support"], stats=stats)
+    wall = time.perf_counter() - t0
+
+    total_pairs = int(stats.get("total_pairs", 0))
+    return {
+        "config": config_id,
+        "label": spec["label"],
+        "strategy": strategy,
+        "wall_s": round(wall, 3),
+        "total_pairs": total_pairs,
+        "pairs_per_sec_per_chip": round(total_pairs / wall, 1) if wall else 0,
+        "cinds": len(table),
+        "cind_families": _cind_family_counts(table),
+        "n_triples": spec["n"],
+        "min_support": spec["min_support"],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="1,2")
+    ap.add_argument("--strategies", default="0,1,2")
+    args = ap.parse_args()
+
+    import jax
+    backend = jax.devices()[0].platform
+    print(f"backend: {backend}", file=sys.stderr)
+
+    rows = []
+    for cid in (int(c) for c in args.configs.split(",")):
+        for strat in (int(s) for s in args.strategies.split(",")):
+            try:
+                row = run_one(cid, strat)
+            except Exception as e:  # keep reporting the rest of the matrix
+                row = {"config": cid, "strategy": strat,
+                       "error": f"{type(e).__name__}: {e}"}
+            row["backend"] = backend
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+    print(f"{'cfg':>3} {'strat':>5} {'wall_s':>9} {'Mpairs/s':>9} "
+          f"{'cinds':>8}", file=sys.stderr)
+    for r in rows:
+        if "error" in r:
+            print(f"{r['config']:>3} {r['strategy']:>5} ERROR {r['error']}",
+                  file=sys.stderr)
+        else:
+            print(f"{r['config']:>3} {r['strategy']:>5} {r['wall_s']:>9.2f} "
+                  f"{r['pairs_per_sec_per_chip'] / 1e6:>9.2f} "
+                  f"{r['cinds']:>8}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -124,13 +124,18 @@ def ingest_files(paths, tabs: bool = False, expect_quad: bool = False,
         lib.rdf_ingest_free(h)
     raw = buf.tobytes()
     values = np.empty(n_values, object)
-    try:
-        raw.decode("utf-8")
-        lossless = True
-    except UnicodeDecodeError:
-        lossless = False
+    # Probe losslessness per value, not on the concatenated blob: an invalid
+    # suffix of one value can splice with an invalid prefix of the next into a
+    # valid sequence (b"\xc3" + b"\xa9" == "é"), so a whole-blob decode can
+    # succeed while individual values are invalid.
+    lossless = True
     for i in range(n_values):
-        values[i] = raw[offsets[i]:offsets[i + 1]].decode(errors="replace")
+        chunk = raw[offsets[i]:offsets[i + 1]]
+        try:
+            values[i] = chunk.decode("utf-8")
+        except UnicodeDecodeError:
+            values[i] = chunk.decode(errors="replace")
+            lossless = False
     if not lossless and n_values:
         # Invalid UTF-8: errors="replace" can reorder or even conflate values
         # relative to the native byte-sort ranks, breaking Dictionary's

@@ -261,6 +261,85 @@ def _lines_step(triples, n_valid, min_support, *, mesh, projections, use_fis,
 
 
 # ---------------------------------------------------------------------------
+# Load-aware placement (P2b): greedy least-loaded reassignment of hot lines.
+#
+# Exchange A places lines purely by hash(join value); several mid-sized hot
+# lines (above average but below the giant-split threshold) can land on one
+# device and skew the quadratic pair work.  The reference assigns every line
+# greedily to the least-loaded bin by size² priority
+# (operators/LoadBasedPartitioner.scala:13-52); here hash stays the base
+# placement and only the measured hot tail is greedily reassigned: each device
+# reports its heaviest above-average lines + its base load, the host computes
+# the greedy placement, and only lines whose owner changes move (whole lines —
+# nothing downstream depends on which device owns a line: exchange B/C route
+# by capture hash and level flags are replicated).
+# ---------------------------------------------------------------------------
+
+_HOT_FACTOR = 2.0   # a line is "hot" when its load exceeds avg * _HOT_FACTOR
+_CAP_HOT = 256      # heaviest hot lines reported per device
+_REBALANCE_MIN_GAIN = 0.9  # move only if the planned max drops below 90%
+
+
+def _hotlines_device(jv, n_rows):
+    """Heaviest above-average lines (jv, length) + base load of this device."""
+    n = jv.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
+    pos, length, _, _ = pairs.line_layout(jv, n_rows[0])
+    is_start = valid & (pos == 0)
+    len_f = length.astype(jnp.float32)
+    load_f = len_f * (len_f - 1.0)
+    total_load = jax.lax.psum(jnp.where(is_start, load_f, 0.0).sum(), AXIS)
+    total_lines = jax.lax.psum(is_start.sum(), AXIS)
+    avg_load = total_load / jnp.maximum(total_lines, 1).astype(jnp.float32)
+    hot = is_start & (load_f > avg_load * _HOT_FACTOR)
+    order = jnp.argsort(jnp.where(hot, -load_f, jnp.inf))[:min(_CAP_HOT, n)]
+    hot_jv = jnp.where(hot[order], jv[order], SENTINEL)
+    hot_len = jnp.where(hot[order], length[order], 0)
+    # Report the device's TOTAL load; the host subtracts the reported lines'
+    # loads itself.  (Subtracting all hot lines here would lose the load of
+    # hot lines beyond the _CAP_HOT report cap and skew the host's model.)
+    dev_load = jnp.where(is_start, load_f, 0.0).sum()
+    return hot_jv, hot_len, jnp.full(1, dev_load, jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def _hotlines_step(jv, n_rows, *, mesh):
+    return jax.shard_map(_hotlines_device, mesh=mesh, in_specs=(P(AXIS),) * 2,
+                         out_specs=P(AXIS), check_vma=False)(jv, n_rows)
+
+
+def _rebalance_device(jv, code, v1, v2, n_rows, moved_jv, moved_dest, *,
+                      cap_move):
+    """Ship rows of reassigned lines to their new owners; keep the rest."""
+    n = jv.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
+    my_idx = jax.lax.axis_index(AXIS)
+    h = moved_jv.shape[0]
+    i = jnp.clip(jnp.searchsorted(moved_jv, jv), 0, h - 1)
+    match = valid & (moved_jv[i] == jv)
+    dest = jnp.where(match, moved_dest[i], my_idx)
+    moving = match & (dest != my_idx)
+    stay = valid & ~moving
+    mcols, mvalid, ovf = exchange.bucket_exchange([jv, code, v1, v2], moving,
+                                                  dest, AXIS, cap_move)
+    cols_all = [jnp.concatenate([a, b])
+                for a, b in zip([jv, code, v1, v2], mcols)]
+    valid_all = jnp.concatenate([stay, mvalid])
+    cols, _, _, n2 = segments.masked_unique(cols_all, valid_all)
+    return (*cols, jnp.full(1, n2, jnp.int32), jnp.full(1, ovf, jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "cap_move"))
+def _rebalance_step(jv, code, v1, v2, n_rows, moved_jv, moved_dest, *, mesh,
+                    cap_move):
+    fn = functools.partial(_rebalance_device, cap_move=cap_move)
+    return jax.shard_map(fn, mesh=mesh,
+                         in_specs=(P(AXIS),) * 5 + (P(), P()),
+                         out_specs=P(AXIS), check_vma=False)(
+        jv, code, v1, v2, n_rows, moved_jv, moved_dest)
+
+
+# ---------------------------------------------------------------------------
 # Capture table (P3): exchange B support counting at the capture owner.
 # ---------------------------------------------------------------------------
 
@@ -527,6 +606,9 @@ class _Pipeline:
         self.cap_gp = _headroom(2 * int(plan[3]), floor=1 << 10)
         self.cap_c = segments.pow2_capacity(self.cap_p + self.cap_gp)
 
+        # P2b: load-aware placement of the measured hot tail.
+        self._maybe_rebalance()
+
         # P3: capture table (retry on B overflow).
         for _ in range(max_retries):
             out = _captures_step(*self.lines, self.n_rows, mesh=mesh,
@@ -547,6 +629,77 @@ class _Pipeline:
                 freq=self.cap_f, exchange_a=self.cap_a, exchange_b=self.cap_b,
                 pairs=self.cap_p, exchange_c=self.cap_c, giant_rows=self.cap_g,
                 giant_pairs=self.cap_gp)
+
+    def _maybe_rebalance(self):
+        """Greedy least-loaded reassignment of hot lines (the reference's
+        LoadBasedPartitioner semantics over measured loads)."""
+        if self.num_dev <= 1:
+            return
+        hot_jv, hot_len, dev_load = _hotlines_step(self.lines[0], self.n_rows,
+                                                   mesh=self.mesh)
+        hot_jv = np.asarray(hot_jv).reshape(self.num_dev, -1)
+        hot_len = np.asarray(hot_len).reshape(self.num_dev, -1)
+        cur = np.asarray(dev_load).astype(np.float64)  # (D,) total load
+        mask = hot_jv != int(SENTINEL)
+        if not mask.any():
+            return
+        src = np.nonzero(mask)[0]
+        jvs = hot_jv[mask]
+        lens = hot_len[mask].astype(np.int64)
+        loads = lens.astype(np.float64) * (lens - 1)
+
+        # Base = everything not individually reassignable (cold lines + hot
+        # lines beyond the per-device report cap).
+        base = cur.copy()
+        np.add.at(base, src, -loads)
+        bins = base.copy()
+        dest = np.empty(len(jvs), np.int64)
+        for k in np.argsort(-loads):  # heaviest first, least-loaded bin wins
+            d = int(np.argmin(bins))
+            dest[k] = d
+            bins[d] += loads[k]
+        if self.stats is not None:
+            mean = max(cur.mean(), 1.0)
+            self.stats["rebalance"] = dict(
+                hot_lines=int(len(jvs)),
+                moved_lines=int((dest != src).sum()),
+                load_max_over_mean_before=round(cur.max() / mean, 3),
+                load_max_over_mean_planned=round(bins.max() / mean, 3))
+        if bins.max() >= cur.max() * _REBALANCE_MIN_GAIN:
+            if self.stats is not None:
+                self.stats["rebalance"]["moved_lines"] = 0
+            return  # hash placement is already close enough to balanced
+        moving = dest != src
+        if not moving.any():
+            return
+        mj, md, ml = jvs[moving], dest[moving], lens[moving]
+        order = np.argsort(mj)
+        mj, md, ml = mj[order], md[order], ml[order]
+        # Per-(src, dst) moved-row volume bounds the exchange capacity.
+        vol = np.zeros((self.num_dev, self.num_dev), np.int64)
+        np.add.at(vol, (src[moving], dest[moving]), lens[moving])
+        cap_move = _headroom(int(vol.max()), floor=1 << 8)
+        h = segments.pow2_capacity(len(mj))
+        moved_jv = np.full(h, int(SENTINEL), np.int32)
+        moved_jv[:len(mj)] = mj
+        moved_dest = np.zeros(h, np.int32)
+        moved_dest[:len(mj)] = md
+        for _ in range(self.max_retries):
+            out = _rebalance_step(*self.lines, self.n_rows,
+                                  jnp.asarray(moved_jv),
+                                  jnp.asarray(moved_dest),
+                                  mesh=self.mesh, cap_move=cap_move)
+            *cols, n_rows, ovf = out
+            ovf = int(np.asarray(ovf)[0])
+            if ovf == 0:
+                break
+            cap_move = segments.pow2_capacity(2 * cap_move + ovf)
+        else:
+            raise RuntimeError(
+                f"rebalance overflow persisted after {self.max_retries} "
+                f"retries ({ovf})")
+        self.lines = cols
+        self.n_rows = n_rows
 
     def _pair_caps(self):
         return dict(cap_pairs=self.cap_p, cap_exchange_c=self.cap_c,

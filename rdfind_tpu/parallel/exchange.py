@@ -82,8 +82,12 @@ def route(cols, valid, bucket, axis_name: str, capacity: int):
         out_cols.append(recv.reshape(-1))
 
     # Validity travels as its own lane so payload SENTINELs stay representable.
+    # NB: `ok` is already in sorted order (aligned with `flat`), unlike the
+    # payload columns above which are in original order — indexing it with
+    # `perm` again would sample validity from unrelated rows and silently drop
+    # rows whenever the valid mask is not a compacted prefix.
     vbuf = jnp.zeros(d * capacity, jnp.int32).at[flat].set(
-        ok.astype(jnp.int32)[perm], mode="drop").reshape(d, capacity)
+        ok.astype(jnp.int32), mode="drop").reshape(d, capacity)
     recv_v = jax.lax.all_to_all(vbuf, axis_name, split_axis=0, concat_axis=0,
                                 tiled=True)
     state = RouteState(perm=perm, flat=flat, ok=ok, num_dev=d, capacity=capacity)

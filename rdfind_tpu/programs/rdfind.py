@@ -52,7 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ar-output", default=None, help="association-rule output file")
     p.add_argument("--collect-result", action="store_true",
                    help="print CINDs to stdout")
-    p.add_argument("--debug-level", type=int, default=0)
+    p.add_argument("--debug-level", type=int, default=0,
+                   help="1: phase timings; 2: + sanity checks (trivial-CIND "
+                        "count); 3: + print every CIND")
+    p.add_argument("--print-plan", action="store_true",
+                   help="dump the logical plan as JSON before executing")
     p.add_argument("--counters", type=int, default=0, dest="counter_level")
     p.add_argument("--dop", type=int, default=1,
                    help="degree of parallelism = number of devices in the mesh")
@@ -80,6 +84,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rebalance-threshold", type=float, default=1.0,
                    help=argparse.SUPPRESS)
     p.add_argument("--hash-function", default="MD5", help=argparse.SUPPRESS)
+    p.add_argument("--encoding", default="utf-8",
+                   help="input charset; 'auto' sniffs a BOM per file "
+                        "(default utf-8)")
+    p.add_argument("--file-filter", default=None,
+                   help="regex on input-file basenames (the reference's "
+                        "file-filtered directory scan)")
     p.add_argument("--no-native-ingest", action="store_true",
                    help="force the pure-Python ingest path")
     p.add_argument("--checkpoint-dir", default=None,
@@ -125,6 +135,9 @@ def main(argv=None) -> int:
         explicit_threshold=args.explicit_threshold,
         sbf_bits=args.sbf_bits,
         balanced_11=args.balanced_11,
+        print_plan=args.print_plan,
+        encoding=args.encoding,
+        file_filter=args.file_filter,
     )
     result = driver.run(cfg)
     if not (cfg.output_file or cfg.collect_result):

@@ -26,9 +26,16 @@ from ..data import CindTable
 from ..dictionary import Dictionary
 
 
+# Folded into every fingerprint; bump whenever a stage codec or any algorithm
+# upstream of a checkpointed artifact changes meaning, so stale checkpoints
+# from older code can never satisfy a newer run.
+CHECKPOINT_FORMAT = 1
+
+
 def fingerprint(payload: dict) -> str:
-    """Stable digest of a JSON-serializable payload."""
-    blob = json.dumps(payload, sort_keys=True, default=str)
+    """Stable digest of a JSON-serializable payload (+ the format version)."""
+    blob = json.dumps({"__format__": CHECKPOINT_FORMAT, **payload},
+                      sort_keys=True, default=str)
     return hashlib.sha256(blob.encode()).hexdigest()
 
 
@@ -102,3 +109,24 @@ def encode_cinds(table: CindTable) -> dict:
 
 def decode_cinds(arrays: dict) -> CindTable:
     return CindTable(*(arrays[c] for c in _CIND_COLS))
+
+
+def encode_stats(stats: dict) -> dict:
+    """Scalar pipeline stats ride along with the discover stage so resumed runs
+    report the same stat-* counters as the run that produced the checkpoint."""
+    scalars = {}
+    for k, v in stats.items():
+        if isinstance(v, (bool, str)):
+            scalars[k] = v
+        elif isinstance(v, (int, np.integer)):
+            scalars[k] = int(v)
+        elif isinstance(v, (float, np.floating)):
+            scalars[k] = float(v)
+    blob = json.dumps(scalars, sort_keys=True).encode()
+    return {"__stats__": np.frombuffer(blob, np.uint8)}
+
+
+def decode_stats(arrays: dict) -> dict:
+    if "__stats__" not in arrays:
+        return {}
+    return json.loads(bytes(arrays["__stats__"]).decode())

@@ -55,6 +55,9 @@ class Config:
     explicit_threshold: int = -1  # != -1: half-approximate 1/1 (strategy 1)
     sbf_bits: int = -1  # count-min counter bits (-1 = sized to min_support)
     balanced_11: bool = False  # halve 1/1 emission via pair ownership
+    print_plan: bool = False  # dump the logical plan as JSON before executing
+    encoding: str = "utf-8"  # input charset; "auto" sniffs a BOM per file
+    file_filter: str | None = None  # regex on input-file basenames
 
 
 @dataclasses.dataclass
@@ -82,7 +85,7 @@ class _Phases:
 
 def _resolve_inputs(cfg: Config):
     """Input paths + quad-format sniff (shared by the native and Python paths)."""
-    paths = reader.resolve_path_patterns(cfg.input_paths)
+    paths = reader.resolve_path_patterns(cfg.input_paths, cfg.file_filter)
     is_nq = paths[0].endswith((".nq", ".nq.gz"))
     return paths, is_nq
 
@@ -93,7 +96,7 @@ def load_triples(cfg: Config, phases: _Phases, counters: dict):
 
     def parse_all():
         out = []
-        for _, line in reader.iter_lines(paths):
+        for _, line in reader.iter_lines(paths, encoding=cfg.encoding):
             t = (ntriples.parse_tab_line(line) if cfg.tabs
                  else ntriples.parse_line(line, expect_quad=is_nq))
             if t is not None:
@@ -130,7 +133,7 @@ def _checkpoint_fps(cfg: Config, use_native: bool):
     paths, is_nq = _resolve_inputs(cfg)
     ingest_payload = dict(
         inputs=checkpoint.input_signature(paths), is_nq=is_nq, tabs=cfg.tabs,
-        asciify=cfg.asciify_triples,
+        asciify=cfg.asciify_triples, encoding=cfg.encoding,
         prefixes=(checkpoint.input_signature(
             reader.resolve_path_patterns(cfg.prefix_paths))
             if cfg.prefix_paths else []),
@@ -162,15 +165,109 @@ def _half_approx_active(cfg: Config) -> bool:
             and cfg.n_devices == 1)
 
 
+# Logical stages of each traversal strategy, for --print-plan (the analog of
+# the reference's Flink execution-plan JSON dump, programs/RDFind.scala:75-81).
+_STRATEGY_PLANS = {
+    0: ["emit-join-candidates", "group-by-join-value",
+        "pair-phase (co-occurrence matmul / chunked counts)",
+        "intersect-refsets", "support-filter", "split-cind-sets"],
+    1: ["emit-join-candidates", "group-by-join-value",
+        "overlap-1/1", "cind-1/1",
+        "generate-1/2", "extract-1/2",
+        "generate-2/1", "extract-2/1", "infer-2/1 (from 1/1)",
+        "generate-2/2", "prune-2/2-vs-1/2", "extract-2/2",
+        "union-families"],
+    2: ["emit-join-candidates", "group-by-join-value",
+        "round-1: bloom refset sketches + containment matmul",
+        "round-2: exact re-verification of sketch candidates",
+        "support-filter", "split-cind-sets"],
+    3: ["emit-join-candidates", "group-by-join-value",
+        "round-1: half-approximate unary-dependent CINDs",
+        "round-2: binary dependents pruned by round-1 CINDs",
+        "union-rounds", "split-cind-sets"],
+}
+
+
+def describe_plan(cfg: Config) -> dict:
+    """A JSON-able description of the stages this config will execute."""
+    pre = ["read+parse"]
+    if cfg.asciify_triples:
+        pre.append("asciify")
+    if cfg.prefix_paths:
+        pre.append("shorten-urls")
+    pre.append("intern")
+    if cfg.distinct_triples:
+        pre.append("distinct")
+    discover = list(_STRATEGY_PLANS.get(cfg.traversal_strategy, ["unknown"]))
+    if cfg.use_frequent_item_set:
+        discover.insert(0, "frequent-item-sets (condition-support filter)")
+    if cfg.use_association_rules and cfg.use_frequent_item_set:
+        discover.insert(1, "association-rules (emission suppression + filter)")
+    if _half_approx_active(cfg):
+        for i, s in enumerate(discover):
+            if s == "overlap-1/1":
+                discover[i] = ("overlap-1/1 (half-approximate: explicit top-K "
+                               "+ count-min spill, two-round)")
+    if cfg.clean_implied:
+        discover.append("remove-implied-cinds")
+    sinks = []
+    if cfg.output_file:
+        sinks.append(f"write-output -> {cfg.output_file}")
+    if cfg.ar_output_file:
+        sinks.append(f"write-ar-output -> {cfg.ar_output_file}")
+    if cfg.collect_result:
+        sinks.append("collect-result (stdout)")
+    return {
+        "strategy": cfg.traversal_strategy,
+        "n_devices": cfg.n_devices,
+        "backend": "sharded-mesh" if cfg.n_devices > 1 else "single-device",
+        "min_support": cfg.min_support,
+        "projections": cfg.projections,
+        "stages": {"ingest": pre, "discover": discover, "sinks": sinks},
+    }
+
+
+def _trivial_cind_mask(table: CindTable) -> np.ndarray:
+    """True where a CIND is trivially implied by its own dependent capture:
+    same projection and the referenced condition is a value-matching sub-
+    condition of the dependent one (Condition.implies semantics,
+    data/Condition.scala:35-43).  These must never appear in the output; the
+    reference counts them at DEBUG_LEVEL_SANITY (RDFind.scala:497-504)."""
+    from .. import conditions as cc
+
+    dep = np.asarray(table.dep_code)
+    ref = np.asarray(table.ref_code)
+    same_proj = cc.secondary(dep) == cc.secondary(ref)
+    sub = cc.is_subcode(cc.primary(ref), cc.primary(dep))
+    d1, d2, _ = cc.decode(dep)
+    r1, r2, _ = cc.decode(ref)
+    dv1 = np.asarray(table.dep_v1)
+    dv2 = np.asarray(table.dep_v2)
+    rv1 = np.asarray(table.ref_v1)
+    rv2 = np.asarray(table.ref_v2)
+
+    def dep_val(field):  # dependent's condition value on a single-bit field
+        return np.where(field == d1, dv1, np.where(field == d2, dv2, -1))
+
+    v_ok = np.where(r1 != 0, dep_val(r1) == rv1, True) & np.where(
+        r2 != 0, dep_val(r2) == rv2, True)
+    return same_proj & sub & v_ok
+
+
 def run(cfg: Config) -> RunResult:
     phases = _Phases()
     counters: dict = {}
+
+    if cfg.print_plan:
+        import json as _json
+        print(_json.dumps(describe_plan(cfg), indent=2))
 
     # Native fused ingest (read+parse+intern in one C++ pass) whenever the
     # string-level preprocessing options that need raw tokens are off.
     use_native = (cfg.native_ingest and native.available()
                   and not cfg.asciify_triples and not cfg.prefix_paths
-                  and not cfg.only_read)
+                  and not cfg.only_read
+                  and cfg.encoding == "utf-8")  # native parser is UTF-8-only
 
     ckpt = ingest_fp = discover_fp = None
     if cfg.checkpoint_dir and not cfg.only_read:
@@ -307,14 +404,27 @@ def run(cfg: Config) -> RunResult:
         if stored is not None:
             table = phases.run("resume-discover",
                                lambda: checkpoint.decode_cinds(stored))
+            stats.update(checkpoint.decode_stats(stored))
             counters["resumed-discover"] = 1
     if table is None:
         table = phases.run("discover", discover)
         if ckpt is not None:
-            phases.run("checkpoint-discover", lambda: ckpt.save(
-                "discover", discover_fp, checkpoint.encode_cinds(table)))
+            def save_discover():
+                arrays = checkpoint.encode_cinds(table)
+                arrays.update(checkpoint.encode_stats(stats))
+                ckpt.save("discover", discover_fp, arrays)
+            phases.run("checkpoint-discover", save_discover)
     counters["cind-counter"] = len(table)
     counters.update({f"stat-{k}": v for k, v in stats.items()})
+
+    if cfg.debug_level >= 2 and len(table):
+        # DEBUG_LEVEL_SANITY: trivial CINDs in the output indicate a pipeline
+        # bug (the reference's check, RDFind.scala:497-504).
+        n_trivial = int(np.count_nonzero(_trivial_cind_mask(table)))
+        counters["sanity-trivial-cinds"] = n_trivial
+        if n_trivial:
+            print(f"SANITY VIOLATION: {n_trivial} trivial CINDs in output",
+                  file=sys.stderr)
 
     if cfg.ar_output_file and not cfg.use_frequent_item_set:
         # Reference parity: without --use-fis there are no frequent-item sets to

@@ -92,3 +92,30 @@ def test_cind_codec_roundtrip():
     t = CindTable(*(np.arange(i, i + 3, dtype=np.int64) for i in range(7)))
     out = checkpoint.decode_cinds(checkpoint.encode_cinds(t))
     assert out.to_rows() == t.to_rows()
+
+
+def test_stats_survive_resume(fixture_nt, tmp_path):
+    """stat-* counters come back identical on a resumed discover stage."""
+    cfg = make_cfg(fixture_nt, tmp_path)
+    first = driver.run(cfg)
+    first_stats = {k: v for k, v in first.counters.items()
+                   if k.startswith("stat-") and isinstance(v, (int, float, str))}
+    assert first_stats, "expected the pipeline to record scalar stats"
+    second = driver.run(cfg)
+    assert second.counters["resumed-discover"] == 1
+    for k, v in first_stats.items():
+        assert second.counters.get(k) == v, k
+
+
+def test_format_version_in_fingerprint(monkeypatch):
+    fp1 = checkpoint.fingerprint({"a": 1})
+    monkeypatch.setattr(checkpoint, "CHECKPOINT_FORMAT",
+                        checkpoint.CHECKPOINT_FORMAT + 1)
+    assert checkpoint.fingerprint({"a": 1}) != fp1
+
+
+def test_stats_codec_keeps_scalars_only():
+    stats = {"n": 3, "f": 1.5, "s": "x", "b": True,
+             "arr": np.arange(3), "tup": (1, 2)}
+    out = checkpoint.decode_stats(checkpoint.encode_stats(stats))
+    assert out == {"n": 3, "f": 1.5, "s": "x", "b": True}

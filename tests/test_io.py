@@ -91,3 +91,62 @@ def test_asciify():
     assert prefixes.asciify("plain") == "plain"
     assert prefixes.asciify("Zürich") == "Zurich"
     assert prefixes.asciify("日本") == "??"
+
+
+def test_reader_name_filter(tmp_path):
+    (tmp_path / "a.nt").write_text("<s1> <p> <o> .\n")
+    (tmp_path / "b.nt").write_text("<s2> <p> <o> .\n")
+    (tmp_path / "skip.txt").write_text("junk\n")
+    paths = reader.resolve_path_patterns([str(tmp_path)], name_filter=r"\.nt$")
+    assert [p.rsplit("/", 1)[1] for p in paths] == ["a.nt", "b.nt"]
+    with pytest.raises(FileNotFoundError):
+        reader.resolve_path_patterns([str(tmp_path)], name_filter=r"\.nope$")
+
+
+def test_reader_bom_sniff(tmp_path):
+    utf16 = tmp_path / "a.nt"
+    utf16.write_bytes('<s1> <p> "héllo" .\n'.encode("utf-16"))  # LE BOM
+    utf8sig = tmp_path / "b.nt"
+    utf8sig.write_bytes('<s2> <p> "x" .\n'.encode("utf-8-sig"))
+    plain = tmp_path / "c.nt"
+    plain.write_text('<s3> <p> "y" .\n')
+    assert reader.sniff_encoding(str(utf16)) == "utf-16"
+    assert reader.sniff_encoding(str(utf8sig)) == "utf-8-sig"
+    assert reader.sniff_encoding(str(plain)) == "utf-8"
+    lines = list(reader.iter_lines(
+        [str(utf16), str(utf8sig), str(plain)], encoding="auto"))
+    # BOMs are stripped, content decodes per-file.
+    assert [ln.split()[0] for _, ln in lines] == ["<s1>", "<s2>", "<s3>"]
+    assert "héllo" in lines[0][1]
+
+
+def test_reader_per_file_encodings(tmp_path):
+    latin = tmp_path / "latin.nt"
+    latin.write_bytes('<s1> <p> "café" .\n'.encode("latin-1"))
+    utf8 = tmp_path / "u.nt"
+    utf8.write_text('<s2> <p> "naïve" .\n')
+    enc = {"latin.nt": "latin-1", None: "utf-8"}
+    lines = dict(reader.iter_lines([str(latin), str(utf8)], encoding=enc))
+    assert "café" in lines[0] and "naïve" in lines[1]
+    # Callable spec.
+    lines2 = dict(reader.iter_lines(
+        [str(latin), str(utf8)],
+        encoding=lambda p: "latin-1" if "latin" in p else "utf-8"))
+    assert lines2 == lines
+
+
+def test_reader_gz_bom_sniff(tmp_path):
+    gz = tmp_path / "a.nt.gz"
+    with gzip.open(gz, "wb") as f:
+        f.write('<s1> <p> "zür" .\n'.encode("utf-16"))
+    assert reader.sniff_encoding(str(gz)) == "utf-16"
+    (_, line), = reader.iter_lines([str(gz)], encoding="auto")
+    assert "zür" in line
+
+
+def test_reader_callable_auto_encoding(tmp_path):
+    f = tmp_path / "a.nt"
+    f.write_bytes('<s1> <p> "é" .\n'.encode("utf-16"))
+    assert reader.encoding_for(str(f), lambda p: "auto") == "utf-16"
+    (_, line), = reader.iter_lines([str(f)], encoding=lambda p: "auto")
+    assert "é" in line

@@ -113,3 +113,16 @@ def test_large_random_parity(tmp_path):
     f = tmp_path / "big.nt"
     f.write_text("\n".join(lines) + "\n")
     assert_same(native.ingest_files([str(f)]), python_path([str(f)]))
+
+
+def test_boundary_spliced_invalid_utf8_parity(tmp_path):
+    """Values that are invalid UTF-8 alone but splice into a valid sequence in
+    the concatenated dictionary blob (b'a\\xc3' + b'\\xa9b' == 'a' + 'é' + 'b')
+    must still decode per-value like the Python path does."""
+    f = tmp_path / "splice.tsv"
+    f.write_bytes(b"a\xc3\t\xa9b\tZ\n")
+    got = native.ingest_files([str(f)], tabs=True)
+    want = python_path([str(f)], tabs=True)
+    assert_same(got, want)
+    # Each invalid value decoded independently (with U+FFFD), never conflated.
+    assert len(set(got[1].values)) == len(got[1].values)

@@ -130,3 +130,29 @@ def test_rdfind_ar_output(tmp_path, capsys):
     assert rc == 0
     lines = out.read_text().splitlines()
     assert "[p=<p1>] -> [o=<x>] (support=2,confidence=100.00%)" in lines
+
+
+def test_rdfind_print_plan_and_sanity(fixture_file, capsys):
+    import json
+    rc = rdfind.main([fixture_file, "--support", "1", "--print-plan",
+                      "--debug-level", "2", "--counters", "1"])
+    assert rc == 0
+    out, err = capsys.readouterr()
+    plan = json.loads(out[:out.index("\n}") + 2])
+    assert plan["strategy"] == 1
+    assert "overlap-1/1" in plan["stages"]["discover"]
+    assert plan["stages"]["ingest"][0] == "read+parse"
+    # DEBUG_LEVEL_SANITY: trivial-CIND count reported, and it is zero.
+    assert "sanity-trivial-cinds: 0" in err
+
+
+def test_rdfind_file_filter_and_encoding(tmp_path, capsys):
+    (tmp_path / "a.nt").write_bytes(
+        '<s1> <p> "é" .\n<s2> <p> "é" .\n'.encode("utf-16"))
+    (tmp_path / "ignore.txt").write_text("not rdf\n")
+    rc = rdfind.main([str(tmp_path), "--file-filter", r"\.nt$",
+                      "--encoding", "auto", "--support", "1",
+                      "--counters", "1"])
+    assert rc == 0
+    _, err = capsys.readouterr()
+    assert "input-triples: 2" in err
