@@ -66,6 +66,7 @@ needed, because every grouping is a hash-bucketed sort on the owning device.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
 
@@ -97,6 +98,37 @@ def _masked_counts(valid, inverse, num_segments):
 # _MIN_SPLIT_LOAD pairs — replication overhead would beat the win.
 REBALANCE_FACTOR = 8.0
 _MIN_SPLIT_LOAD = 256
+
+
+@dataclasses.dataclass(frozen=True)
+class SkewPolicy:
+    """Tunable skew-engine policy (the reference's --rebalance-* flags,
+    programs/RDFind.scala:689-698 + AssignJoinLineRebalancing.scala:48-64).
+
+    strategy  -- how a split line's dependents are owned across devices:
+                 1 = hash-slice (CreateDependencyCandidates.scala:141-147),
+                 2 = contiguous range-slice (:148-154);
+    factor    -- a line splits when its quadratic load exceeds
+                 factor * global average (--rebalance-threshold scales this);
+    max_load  -- absolute load above which a line always splits
+                 (--rebalance-max-load, reference default 10000*10000).
+
+    Frozen (hashable) so it can ride jit static_argnames: each distinct policy
+    compiles once.
+    """
+
+    strategy: int = 1
+    factor: float = REBALANCE_FACTOR
+    max_load: float = 10_000.0 * 10_000.0
+
+    def __post_init__(self):
+        if self.strategy not in (1, 2):
+            raise ValueError(
+                f"rebalance strategy must be 1 (hash-slice) or 2 "
+                f"(range-slice), got {self.strategy}")
+
+
+DEFAULT_SKEW = SkewPolicy()
 
 # Hash seeds shared between the planning histograms and the real exchanges —
 # planning is only exact because both sides bucket identically.
@@ -159,7 +191,7 @@ def _bucket_max(cols, valid, seed):
     return jax.lax.pmax(hist[:num_dev].max(), AXIS)
 
 
-def _plan_device(triples, n_valid, *, projections, use_fis):
+def _plan_device(triples, n_valid, *, projections, use_fis, combine=True):
     """Measured capacity needs for the frequency exchanges and exchange A."""
     t = triples.shape[0]
     valid_t = jnp.arange(t, dtype=jnp.int32) < n_valid[0]
@@ -173,16 +205,21 @@ def _plan_device(triples, n_valid, *, projections, use_fis):
     # Exchange A load: unfiltered emission is an upper bound on the filtered one.
     cands = emit_join_candidates(triples, frequency.no_filter(valid_t),
                                  projections)
-    cols, valid, _, _ = segments.masked_unique(
-        [cands.join_val, cands.code, cands.v1, cands.v2], cands.valid)
+    if combine:
+        cols, valid, _, _ = segments.masked_unique(
+            [cands.join_val, cands.code, cands.v1, cands.v2], cands.valid)
+    else:
+        cols, valid = [cands.join_val], cands.valid
     cap_a = _bucket_max([cols[0]], valid, _SEED_VALUE)
     return jnp.full(1, cap_f, jnp.int32), jnp.full(1, cap_a, jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "projections", "use_fis"))
-def _plan_step(triples, n_valid, *, mesh, projections, use_fis):
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "projections", "use_fis",
+                                    "combine"))
+def _plan_step(triples, n_valid, *, mesh, projections, use_fis, combine=True):
     fn = functools.partial(_plan_device, projections=projections,
-                           use_fis=use_fis)
+                           use_fis=use_fis, combine=combine)
     return jax.shard_map(fn, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS)),
                          out_specs=P(AXIS), check_vma=False)(triples, n_valid)
 
@@ -194,7 +231,8 @@ def _plan_step(triples, n_valid, *, mesh, projections, use_fis):
 
 
 def _lines_device(triples, n_valid, min_support, *, projections, use_fis,
-                  use_ars, cap_freq, cap_exchange_a):
+                  use_ars, cap_freq, cap_exchange_a, skew=DEFAULT_SKEW,
+                  combine=True):
     t = triples.shape[0]
     valid_t = jnp.arange(t, dtype=jnp.int32) < n_valid[0]
     num_dev = jax.lax.psum(1, AXIS)
@@ -205,10 +243,17 @@ def _lines_device(triples, n_valid, min_support, *, projections, use_fis,
     else:
         freq, ovf_f = frequency.no_filter(valid_t), jnp.int32(0)
 
-    # Emission + local dedupe (combiner side of the join, cf. UnionJoinCandidates).
+    # Emission + local dedupe (combiner side of the join, cf.
+    # UnionJoinCandidates).  combine=False ships raw candidate rows instead
+    # (the reference's --no-combinable-join ablation, RDFind.scala:336-345 /
+    # UnionConditions path) — same output, more exchange volume.
     cands = emit_join_candidates(triples, freq, projections)
-    cols, valid, _, _ = segments.masked_unique(
-        [cands.join_val, cands.code, cands.v1, cands.v2], cands.valid)
+    if combine:
+        cols, valid, _, _ = segments.masked_unique(
+            [cands.join_val, cands.code, cands.v1, cands.v2], cands.valid)
+    else:
+        cols = [cands.join_val, cands.code, cands.v1, cands.v2]
+        valid = cands.valid
 
     # Exchange A: co-locate equal join values.
     bucket = hashing.bucket_of([cols[0]], num_dev, seed=_SEED_VALUE)
@@ -230,7 +275,9 @@ def _lines_device(triples, n_valid, min_support, *, projections, use_fis,
     avg_load = total_load / jnp.maximum(total_lines, 1).astype(jnp.float32)
     # No cap_pairs backstop here (it is what we are planning); the real pair
     # phase may split a few more lines, which only lowers the normal budget.
-    thresh = jnp.maximum(avg_load * REBALANCE_FACTOR, jnp.float32(_MIN_SPLIT_LOAD))
+    thresh = jnp.minimum(
+        jnp.maximum(avg_load * skew.factor, jnp.float32(_MIN_SPLIT_LOAD)),
+        jnp.float32(skew.max_load))
     is_giant = valid & (load_f > thresh)
     norm_pairs = jnp.where(valid & ~is_giant, length - 1, 0)
     cap_p = jax.lax.pmax(pairs.saturating_cumsum(norm_pairs)[-1], AXIS)
@@ -248,12 +295,14 @@ def _lines_device(triples, n_valid, min_support, *, projections, use_fis,
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "projections", "use_fis", "use_ars", "cap_freq",
-                     "cap_exchange_a"))
+                     "cap_exchange_a", "skew", "combine"))
 def _lines_step(triples, n_valid, min_support, *, mesh, projections, use_fis,
-                use_ars, cap_freq, cap_exchange_a):
+                use_ars, cap_freq, cap_exchange_a, skew=DEFAULT_SKEW,
+                combine=True):
     fn = functools.partial(_lines_device, projections=projections,
                            use_fis=use_fis, use_ars=use_ars, cap_freq=cap_freq,
-                           cap_exchange_a=cap_exchange_a)
+                           cap_exchange_a=cap_exchange_a, skew=skew,
+                           combine=combine)
     return jax.shard_map(fn, mesh=mesh,
                          in_specs=(P(AXIS, None), P(AXIS), P()),
                          out_specs=P(AXIS), check_vma=False)(
@@ -280,8 +329,14 @@ _CAP_HOT = 256      # heaviest hot lines reported per device
 _REBALANCE_MIN_GAIN = 0.9  # move only if the planned max drops below 90%
 
 
-def _hotlines_device(jv, n_rows):
-    """Heaviest above-average lines (jv, length) + base load of this device."""
+def _hotlines_device(jv, n_rows, *, skew=DEFAULT_SKEW):
+    """Heaviest above-average lines (jv, length) + base load of this device.
+
+    Lines above the giant-split threshold are excluded from both the report
+    and the load model: the split engine already spreads their pair work
+    across every device, so moving them is pure cost and counting their full
+    load at one bin would distort the greedy placement.
+    """
     n = jv.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
     pos, length, _, _ = pairs.line_layout(jv, n_rows[0])
@@ -291,20 +346,25 @@ def _hotlines_device(jv, n_rows):
     total_load = jax.lax.psum(jnp.where(is_start, load_f, 0.0).sum(), AXIS)
     total_lines = jax.lax.psum(is_start.sum(), AXIS)
     avg_load = total_load / jnp.maximum(total_lines, 1).astype(jnp.float32)
-    hot = is_start & (load_f > avg_load * _HOT_FACTOR)
+    giant_thresh = jnp.minimum(
+        jnp.maximum(avg_load * skew.factor, jnp.float32(_MIN_SPLIT_LOAD)),
+        jnp.float32(skew.max_load))
+    movable = is_start & (load_f <= giant_thresh)
+    hot = movable & (load_f > avg_load * _HOT_FACTOR)
     order = jnp.argsort(jnp.where(hot, -load_f, jnp.inf))[:min(_CAP_HOT, n)]
     hot_jv = jnp.where(hot[order], jv[order], SENTINEL)
     hot_len = jnp.where(hot[order], length[order], 0)
-    # Report the device's TOTAL load; the host subtracts the reported lines'
-    # loads itself.  (Subtracting all hot lines here would lose the load of
-    # hot lines beyond the _CAP_HOT report cap and skew the host's model.)
-    dev_load = jnp.where(is_start, load_f, 0.0).sum()
+    # Report the device's total movable load; the host subtracts the reported
+    # lines' loads itself.  (Subtracting all hot lines here would lose the
+    # load of hot lines beyond the _CAP_HOT report cap and skew the model.)
+    dev_load = jnp.where(movable, load_f, 0.0).sum()
     return hot_jv, hot_len, jnp.full(1, dev_load, jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh",))
-def _hotlines_step(jv, n_rows, *, mesh):
-    return jax.shard_map(_hotlines_device, mesh=mesh, in_specs=(P(AXIS),) * 2,
+@functools.partial(jax.jit, static_argnames=("mesh", "skew"))
+def _hotlines_step(jv, n_rows, *, mesh, skew=DEFAULT_SKEW):
+    fn = functools.partial(_hotlines_device, skew=skew)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(P(AXIS),) * 2,
                          out_specs=P(AXIS), check_vma=False)(jv, n_rows)
 
 
@@ -373,7 +433,8 @@ def _captures_step(jv, code, v1, v2, n_rows, *, mesh, cap_exchange_b):
 
 
 def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
-                cap_exchange_c, cap_giant, cap_giant_pairs):
+                cap_exchange_c, cap_giant, cap_giant_pairs,
+                skew=DEFAULT_SKEW):
     """Skew-aware masked pair counting over value-sorted line rows.
 
     Emits all ordered co-occurrence pairs whose dependent row is dep-flagged and
@@ -402,7 +463,9 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
     total_lines = jax.lax.psum(is_start.sum(), AXIS)
     avg_load = total_load / jnp.maximum(total_lines, 1).astype(jnp.float32)
     thresh = jnp.minimum(
-        jnp.maximum(avg_load * REBALANCE_FACTOR, jnp.float32(_MIN_SPLIT_LOAD)),
+        jnp.minimum(
+            jnp.maximum(avg_load * skew.factor, jnp.float32(_MIN_SPLIT_LOAD)),
+            jnp.float32(skew.max_load)),
         jnp.float32(cap_pairs // 4))  # absolute backstop
     is_giant = valid & (load_f > thresh)
     n_giant_lines = jax.lax.psum((is_start & is_giant).sum(), AXIS)
@@ -434,8 +497,18 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
     dep_fg = gv & (flag_g >= 2)
     ref_fg = gv & (flag_g % 2 == 1)
     posg, leng, startg, _ = pairs.line_layout(jv_g, gv.sum())
-    own = dep_fg & (hashing.bucket_of([code_g, v1_g, v2_g], num_dev,
-                                      seed=_SEED_GIANT) == my_idx)
+    if skew.strategy == 2:
+        # Contiguous range-slice of each line's rows (the reference's split
+        # strategy 2, CreateDependencyCandidates.scala:148-154): device d owns
+        # positions [d*block, (d+1)*block) with block = ceil(len/D).  Division
+        # by the block size (not posg * num_dev, which would overflow int32 on
+        # giant lines) keeps everything in 32 bits.
+        block = jnp.maximum(-(-leng // num_dev), 1)
+        own = dep_fg & (posg // block == my_idx)
+    else:
+        # Hash-slice (split strategy 1, :141-147).
+        own = dep_fg & (hashing.bucket_of([code_g, v1_g, v2_g], num_dev,
+                                          seed=_SEED_GIANT) == my_idx)
     (posd, lend, startd, dc, dv1, dv2), n_own = segments.compact(
         [posg, leng, startg, code_g, v1_g, v2_g], own)
     lend = jnp.where(jnp.arange(lend.shape[0], dtype=jnp.int32) < n_own, lend, 1)
@@ -474,7 +547,7 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
 
 def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
                  min_support, *, cap_pairs, cap_exchange_c, cap_giant,
-                 cap_giant_pairs):
+                 cap_giant_pairs, skew=DEFAULT_SKEW):
     """AllAtOnce finish: all-flag pair phase + support join + CIND test."""
     n = jv.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
@@ -482,7 +555,7 @@ def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
      n_giant_pairs, _) = _pair_phase(
         jv, code, v1, v2, n_rows[0], valid, valid, cap_pairs=cap_pairs,
         cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
-        cap_giant_pairs=cap_giant_pairs)
+        cap_giant_pairs=cap_giant_pairs, skew=skew)
 
     # Support lookup + CIND test (same-device by shared hash _SEED_CAPTURE).
     tbl_valid = jnp.arange(tc.shape[0], dtype=jnp.int32) < n_caps[0]
@@ -505,13 +578,13 @@ def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "cap_pairs", "cap_exchange_c", "cap_giant",
-                     "cap_giant_pairs"))
+                     "cap_giant_pairs", "skew"))
 def _cind_step(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
                min_support, *, mesh, cap_pairs, cap_exchange_c, cap_giant,
-               cap_giant_pairs):
+               cap_giant_pairs, skew=DEFAULT_SKEW):
     fn = functools.partial(_cind_device, cap_pairs=cap_pairs,
                            cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
-                           cap_giant_pairs=cap_giant_pairs)
+                           cap_giant_pairs=cap_giant_pairs, skew=skew)
     return jax.shard_map(fn, mesh=mesh,
                          in_specs=(P(AXIS),) * 10 + (P(),),
                          out_specs=P(AXIS), check_vma=False)(
@@ -563,19 +636,22 @@ class _Pipeline:
     """
 
     def __init__(self, mesh, triples, min_support, projections, use_fis,
-                 use_ars, max_retries, stats):
+                 use_ars, max_retries, stats, skew=None, combine=True):
         self.mesh = mesh
         self.num_dev = mesh.devices.size
         self.min_support = min_support
         self.max_retries = max_retries
         self.stats = stats
+        self.skew = skew if skew is not None else DEFAULT_SKEW
+        self.combine = combine
         padded, n_valid, _ = _shard_triples(triples, self.num_dev)
         self._triples = jnp.asarray(padded)
         self._n_valid = jnp.asarray(n_valid)
 
         # P1: measured plan for the pre-exchange capacities.
         cap_f, cap_a = _plan_step(self._triples, self._n_valid, mesh=mesh,
-                                  projections=projections, use_fis=use_fis)
+                                  projections=projections, use_fis=use_fis,
+                                  combine=combine)
         self.cap_f = _headroom(np.asarray(cap_f)[0]) if use_fis else 1
         self.cap_a = _headroom(np.asarray(cap_a)[0])
 
@@ -584,7 +660,8 @@ class _Pipeline:
             out = _lines_step(
                 self._triples, self._n_valid, jnp.int32(min_support),
                 mesh=mesh, projections=projections, use_fis=use_fis,
-                use_ars=use_ars, cap_freq=self.cap_f, cap_exchange_a=self.cap_a)
+                use_ars=use_ars, cap_freq=self.cap_f, cap_exchange_a=self.cap_a,
+                skew=self.skew, combine=self.combine)
             *line_cols, n_rows, plan, overflow = out
             ovf = np.asarray(overflow).reshape(self.num_dev, 2)[0]
             if int(ovf.sum()) == 0:
@@ -636,7 +713,8 @@ class _Pipeline:
         if self.num_dev <= 1:
             return
         hot_jv, hot_len, dev_load = _hotlines_step(self.lines[0], self.n_rows,
-                                                   mesh=self.mesh)
+                                                   mesh=self.mesh,
+                                                   skew=self.skew)
         hot_jv = np.asarray(hot_jv).reshape(self.num_dev, -1)
         hot_len = np.asarray(hot_len).reshape(self.num_dev, -1)
         cur = np.asarray(dev_load).astype(np.float64)  # (D,) total load
@@ -703,7 +781,8 @@ class _Pipeline:
 
     def _pair_caps(self):
         return dict(cap_pairs=self.cap_p, cap_exchange_c=self.cap_c,
-                    cap_giant=self.cap_g, cap_giant_pairs=self.cap_gp)
+                    cap_giant=self.cap_g, cap_giant_pairs=self.cap_gp,
+                    skew=self.skew)
 
     def _grow_pair_caps(self, ovf):
         if ovf[0] > 0:
@@ -784,7 +863,9 @@ class _Pipeline:
 def discover_sharded(triples, min_support: int, mesh=None, projections: str = "spo",
                      use_fis: bool = False, use_ars: bool = False,
                      clean_implied: bool = False,
-                     max_retries: int = 4, stats: dict | None = None) -> CindTable:
+                     max_retries: int = 4, stats: dict | None = None,
+                     skew: SkewPolicy | None = None,
+                     combine: bool = True) -> CindTable:
     """Discover all CINDs with the full AllAtOnce step sharded over `mesh`.
 
     Output is identical to models.allatonce.discover with matching flags.  If
@@ -801,7 +882,7 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
     use_ars = use_ars and use_fis
 
     pipe = _Pipeline(mesh, triples, min_support, projections, use_fis, use_ars,
-                     max_retries, stats)
+                     max_retries, stats, skew=skew, combine=combine)
     d_code, d_v1, d_v2, r_code, r_v1, r_v2, support = pipe.run_cinds()
 
     table = CindTable(
@@ -826,7 +907,8 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
 
 
 def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
-                     *, cap_pairs, cap_exchange_c, cap_giant, cap_giant_pairs):
+                     *, cap_pairs, cap_exchange_c, cap_giant, cap_giant_pairs,
+                     skew=DEFAULT_SKEW):
     """One level's verification: join flags onto rows, masked pair phase."""
     n = jv.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
@@ -845,7 +927,7 @@ def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
      n_giant_pairs, n_pairs_total) = _pair_phase(
         jv2, code2, v12, v22, n_keep, df2, rf2, cap_pairs=cap_pairs,
         cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
-        cap_giant_pairs=cap_giant_pairs)
+        cap_giant_pairs=cap_giant_pairs, skew=skew)
     out_cols, n_out = segments.compact(list(ucols) + [cooc], uvalid)
     overflow = jnp.stack([ovf_p, ovf_c, ovf_g, ovf_gp])
     return (*out_cols, jnp.full(1, n_out, jnp.int32), overflow,
@@ -857,12 +939,13 @@ def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "cap_pairs", "cap_exchange_c", "cap_giant",
-                     "cap_giant_pairs"))
+                     "cap_giant_pairs", "skew"))
 def _s2l_cooc(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags, *,
-              mesh, cap_pairs, cap_exchange_c, cap_giant, cap_giant_pairs):
+              mesh, cap_pairs, cap_exchange_c, cap_giant, cap_giant_pairs,
+              skew=DEFAULT_SKEW):
     fn = functools.partial(
         _s2l_cooc_device, cap_pairs=cap_pairs, cap_exchange_c=cap_exchange_c,
-        cap_giant=cap_giant, cap_giant_pairs=cap_giant_pairs)
+        cap_giant=cap_giant, cap_giant_pairs=cap_giant_pairs, skew=skew)
     return jax.shard_map(
         fn, mesh=mesh,
         in_specs=(P(AXIS),) * 5 + (P(),) * 5,
@@ -1016,11 +1099,11 @@ def _sharded_sketch_candidates(pipe, cap_table, bits, num_hashes, stats):
 
 def _sharded_prep_approx(triples, min_support, mesh, projections, use_fis,
                          use_ars, max_retries, sketch_bits, sketch_hashes,
-                         stats):
+                         stats, skew=None, combine=True):
     """Shared setup for sharded strategies 2/3: pipeline, frequent-capture
     table, sketch candidates, and the sharded verification backend."""
     pipe = _Pipeline(mesh, triples, min_support, projections, use_fis, use_ars,
-                     max_retries, stats)
+                     max_retries, stats, skew=skew, combine=combine)
     cap_code, cap_v1, cap_v2, dep_count = pipe.capture_table()
     freq_cap = dep_count >= min_support
     cap_table = tuple(a[freq_cap] for a in (cap_code, cap_v1, cap_v2,
@@ -1060,7 +1143,9 @@ def discover_sharded_approx(triples, min_support: int, mesh=None,
                             use_ars: bool = False, clean_implied: bool = False,
                             max_retries: int = 4, sketch_bits: int = 2048,
                             sketch_hashes: int = 4,
-                            stats: dict | None = None) -> CindTable:
+                            stats: dict | None = None,
+                            skew: SkewPolicy | None = None,
+                            combine: bool = True) -> CindTable:
     """Sharded ApproximateAllAtOnce (strategy 2): mesh-tiled sketch containment
     for candidates, exact sharded counting for verification.  Output is
     identical to models.approximate.discover (= raw AllAtOnce)."""
@@ -1076,7 +1161,8 @@ def discover_sharded_approx(triples, min_support: int, mesh=None,
 
     prep = _sharded_prep_approx(triples, min_support, mesh, projections,
                                 use_fis, use_ars, max_retries, sketch_bits,
-                                sketch_hashes, stats)
+                                sketch_hashes, stats, skew=skew,
+                                combine=combine)
     if prep is None:
         return CindTable.empty()
     cap_table, cand_dep, cand_ref, backend = prep
@@ -1093,7 +1179,9 @@ def discover_sharded_late_bb(triples, min_support: int, mesh=None,
                              use_ars: bool = False, clean_implied: bool = False,
                              max_retries: int = 4, sketch_bits: int = 2048,
                              sketch_hashes: int = 4,
-                             stats: dict | None = None) -> CindTable:
+                             stats: dict | None = None,
+                            skew: SkewPolicy | None = None,
+                            combine: bool = True) -> CindTable:
     """Sharded LateBB (strategy 3): one mesh-tiled sketch pass, then the
     unary-dependent round and the 1/x-pruned binary round verify on the mesh.
     Output is identical to models.late_bb.discover."""
@@ -1109,7 +1197,8 @@ def discover_sharded_late_bb(triples, min_support: int, mesh=None,
 
     prep = _sharded_prep_approx(triples, min_support, mesh, projections,
                                 use_fis, use_ars, max_retries, sketch_bits,
-                                sketch_hashes, stats)
+                                sketch_hashes, stats, skew=skew,
+                                combine=combine)
     if prep is None:
         return CindTable.empty()
     cap_table, cand_dep, cand_ref, backend = prep
@@ -1138,7 +1227,9 @@ def discover_sharded_s2l(triples, min_support: int, mesh=None,
                          projections: str = "spo", use_fis: bool = True,
                          use_ars: bool = False, clean_implied: bool = False,
                          max_retries: int = 4,
-                         stats: dict | None = None) -> CindTable:
+                         stats: dict | None = None,
+                         skew: SkewPolicy | None = None,
+                         combine: bool = True) -> CindTable:
     """Sharded SmallToLarge: the reference's default strategy on the mesh.
 
     Join lines are built once and stay device-resident; the host drives the
@@ -1158,7 +1249,7 @@ def discover_sharded_s2l(triples, min_support: int, mesh=None,
     use_ars = use_ars and use_fis
 
     pipe = _Pipeline(mesh, triples, min_support, projections, use_fis, use_ars,
-                     max_retries, stats)
+                     max_retries, stats, skew=skew, combine=combine)
     cap_code, cap_v1, cap_v2, dep_count = pipe.capture_table()
     # Frequent captures only (the single-device capture filter; infrequent ones
     # can appear in no CIND on either side).

@@ -60,18 +60,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--counters", type=int, default=0, dest="counter_level")
     p.add_argument("--dop", type=int, default=1,
                    help="degree of parallelism = number of devices in the mesh")
-    # Accepted-for-compatibility (behavior built-in or pending):
+    # Accepted-for-compatibility (behavior built-in or subsumed; a note is
+    # printed when set so no flag is a *silent* no-op):
     for flag in ("--find-frequent-captures", "--no-bulk-merge",
-                 "--no-combinable-join", "--rebalance-join", "--apply-hash",
+                 "--rebalance-join", "--apply-hash",
                  "--hash-dictionary", "--only-read-compat"):
         p.add_argument(flag, action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--no-combinable-join", action="store_true",
+                   help="plan ablation: ship raw join candidates instead of "
+                        "combiner-deduped ones (sharded runs; same output)")
     p.add_argument("--balanced-overlap-candidates", action="store_true",
                    dest="balanced_11",
                    help="halve the 1/1 overlap emission via pair ownership "
                         "(strategy 1, chunked backend)")
-    for flag, dv in (("--rebalance-strategy", 1), ("--rebalance-split", 1),
-                     ("--rebalance-max-load", 10000 * 10000),
-                     ("--merge-window-size", -1), ("--hash-bytes", -1),
+    p.add_argument("--rebalance-strategy", type=int, default=1,
+                   help="split-line dependent ownership: 1 = hash-slice, "
+                        "2 = contiguous range-slice (sharded runs)")
+    p.add_argument("--rebalance-max-load", type=float, default=10000.0 * 10000,
+                   help="absolute quadratic load above which a join line "
+                        "always splits across devices (sharded runs)")
+    p.add_argument("--merge-window-size", type=int, default=-1,
+                   help="pair-merge window: max pairs materialized per chunk "
+                        "in the chunked backend (-1 = auto)")
+    for flag, dv in (("--rebalance-split", 1), ("--hash-bytes", -1),
                      ("--frequent-condition-strategy", 0),
                      ("--find-only-fcs", 0)):
         p.add_argument(flag, type=int, default=dv, help=argparse.SUPPRESS)
@@ -82,7 +93,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bits per spectral (count-min) counter for the "
                         "half-approximate round (-1 = sized to support)")
     p.add_argument("--rebalance-threshold", type=float, default=1.0,
-                   help=argparse.SUPPRESS)
+                   help="scales the average-load factor above which a join "
+                        "line splits (sharded runs; default 1.0)")
     p.add_argument("--hash-function", default="MD5", help=argparse.SUPPRESS)
     p.add_argument("--encoding", default="utf-8",
                    help="input charset; 'auto' sniffs a BOM per file "
@@ -138,7 +150,30 @@ def main(argv=None) -> int:
         print_plan=args.print_plan,
         encoding=args.encoding,
         file_filter=args.file_filter,
+        rebalance_strategy=args.rebalance_strategy,
+        rebalance_threshold=args.rebalance_threshold,
+        rebalance_max_load=args.rebalance_max_load,
+        merge_window_size=args.merge_window_size,
+        combinable_join=not args.no_combinable_join,
     )
+    # Un-silence the remaining compatibility no-ops (the reference's
+    # JVM-dataflow levers that the TPU design subsumes).
+    for name, why in (
+            ("no_bulk_merge", "merging is always windowed segment-sum here"),
+            ("frequent_condition_strategy",
+             "frequency uses exact segment counts; both reference strategies "
+             "produce identical verdicts"),
+            ("rebalance_split",
+             "split lines always fan out to every device in the mesh"),
+            ("hash_bytes", "hash dictionary subsumed by exact interning"),
+            ("apply_hash", "hash dictionary subsumed by exact interning"),
+            ("hash_dictionary", "hash dictionary subsumed by exact interning")):
+        v = getattr(args, name, None)
+        default = {"rebalance_split": 1, "frequent_condition_strategy": 0,
+                   "hash_bytes": -1}.get(name, False)
+        if v not in (default, None):
+            print(f"note: --{name.replace('_', '-')} has no effect ({why})",
+                  file=sys.stderr)
     result = driver.run(cfg)
     if not (cfg.output_file or cfg.collect_result):
         print(f"Detected {len(result.table)} CINDs.")

@@ -58,6 +58,12 @@ class Config:
     print_plan: bool = False  # dump the logical plan as JSON before executing
     encoding: str = "utf-8"  # input charset; "auto" sniffs a BOM per file
     file_filter: str | None = None  # regex on input-file basenames
+    # Skew-engine policy (sharded runs; the reference's --rebalance-* flags):
+    rebalance_strategy: int = 1  # 1 = hash-slice, 2 = range-slice ownership
+    rebalance_threshold: float = 1.0  # scales the avg-load split factor
+    rebalance_max_load: float = 10_000.0 * 10_000.0  # absolute split trigger
+    merge_window_size: int = -1  # pair-merge window (chunked backend; -1 auto)
+    combinable_join: bool = True  # False: ship raw join candidates (ablation)
 
 
 @dataclasses.dataclass
@@ -341,6 +347,14 @@ def run(cfg: Config) -> RunResult:
             # CINDs, like its single-device form).
             mesh = make_mesh(cfg.n_devices)
             strategy = cfg.traversal_strategy
+            skew = sharded.SkewPolicy(
+                strategy=cfg.rebalance_strategy,
+                factor=sharded.REBALANCE_FACTOR * cfg.rebalance_threshold,
+                max_load=cfg.rebalance_max_load)
+            if cfg.merge_window_size > 0:
+                print("note: --merge-window-size only affects the "
+                      "single-device chunked backend; the sharded run sizes "
+                      "its merge buffers from measured loads", file=sys.stderr)
             if cfg.explicit_threshold != -1:
                 print("note: --explicit-threshold (half-approximate 1/1) is "
                       "single-device only; the sharded run ignores it",
@@ -350,28 +364,34 @@ def run(cfg: Config) -> RunResult:
                       "only; the sharded run ignores it", file=sys.stderr)
             if strategy == 2:
                 return sharded.discover_sharded_approx(
-                    ids, cfg.min_support, mesh=mesh,
+                    ids, cfg.min_support, mesh=mesh, skew=skew, combine=cfg.combinable_join,
                     projections=cfg.projections,
                     use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
                     clean_implied=cfg.clean_implied, stats=stats)
             if strategy == 3:
                 return sharded.discover_sharded_late_bb(
-                    ids, cfg.min_support, mesh=mesh,
+                    ids, cfg.min_support, mesh=mesh, skew=skew, combine=cfg.combinable_join,
                     projections=cfg.projections,
                     use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
                     clean_implied=cfg.clean_implied, stats=stats)
             if strategy == 1:
                 return sharded.discover_sharded_s2l(
-                    ids, cfg.min_support, mesh=mesh,
+                    ids, cfg.min_support, mesh=mesh, skew=skew, combine=cfg.combinable_join,
                     projections=cfg.projections,
                     use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
                     clean_implied=cfg.clean_implied, stats=stats)
             if strategy != 0:
                 raise ValueError(f"unknown traversal strategy {strategy}")
             return sharded.discover_sharded(
-                ids, cfg.min_support, mesh=mesh, projections=cfg.projections,
+                ids, cfg.min_support, mesh=mesh, skew=skew, combine=cfg.combinable_join,
+                projections=cfg.projections,
                 use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
                 clean_implied=cfg.clean_implied, stats=stats)
+        if (cfg.rebalance_strategy != 1 or cfg.rebalance_threshold != 1.0
+                or cfg.rebalance_max_load != 10_000.0 * 10_000.0
+                or not cfg.combinable_join):
+            print("note: --rebalance-*/--no-combinable-join only affect "
+                  "sharded runs (--dop > 1)", file=sys.stderr)
         # Strategy dispatch (TraversalStrategy registry, RDFind.scala:50-56).
         strategy = STRATEGIES.get(cfg.traversal_strategy)
         if strategy is None:
@@ -392,6 +412,11 @@ def run(cfg: Config) -> RunResult:
                       "small-to-large strategy (1)", file=sys.stderr)
             else:
                 kwargs["balanced_11"] = True
+        if cfg.merge_window_size > 0:
+            # The reference's --merge-window-size caps the k-way merge window
+            # (BulkMergeDependencies.scala:96-104); here it caps the pair
+            # budget of one chunk in the chunked backend.
+            kwargs["pair_chunk_budget"] = cfg.merge_window_size
         return strategy(
             ids, cfg.min_support, projections=cfg.projections,
             use_frequent_condition_filter=cfg.use_frequent_item_set,

@@ -156,3 +156,19 @@ def test_rdfind_file_filter_and_encoding(tmp_path, capsys):
     assert rc == 0
     _, err = capsys.readouterr()
     assert "input-triples: 2" in err
+
+
+def test_rdfind_cli_skew_flags(fixture_file, capsys):
+    """--rebalance-* and ablation flags reach the sharded pipeline and keep
+    the output identical."""
+    base = rdfind.main([fixture_file, "--support", "1", "--collect-result"])
+    assert base == 0
+    want, _ = capsys.readouterr()
+    rc = rdfind.main([fixture_file, "--support", "1", "--collect-result",
+                      "--dop", "2", "--rebalance-strategy", "2",
+                      "--rebalance-max-load", "20",
+                      "--rebalance-threshold", "0.5",
+                      "--no-combinable-join"])
+    assert rc == 0
+    got, _ = capsys.readouterr()
+    assert sorted(got.splitlines()) == sorted(want.splitlines())
