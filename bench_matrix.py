@@ -85,8 +85,10 @@ def main():
     ap.add_argument("--strategies", default="0,1,2")
     args = ap.parse_args()
 
-    import jax
-    backend = jax.devices()[0].platform
+    # The axon tunnel can wedge (block inside a C call); use bench.py's
+    # killable-subprocess probe + CPU fallback so the matrix always reports.
+    from bench import _init_backend
+    backend = _init_backend()
     print(f"backend: {backend}", file=sys.stderr)
 
     rows = []

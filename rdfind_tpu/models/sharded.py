@@ -127,6 +127,17 @@ class SkewPolicy:
                 f"rebalance strategy must be 1 (hash-slice) or 2 "
                 f"(range-slice), got {self.strategy}")
 
+    def split_threshold(self, avg_load, cap_pairs: int | None = None):
+        """The giant-split load threshold; the ONE definition shared by the
+        capacity planner, the hot-line report, and the pair phase (drift
+        between copies would desynchronize their load models)."""
+        t = jnp.minimum(
+            jnp.maximum(avg_load * self.factor, jnp.float32(_MIN_SPLIT_LOAD)),
+            jnp.float32(self.max_load))
+        if cap_pairs is not None:  # absolute pair-budget backstop
+            t = jnp.minimum(t, jnp.float32(cap_pairs // 4))
+        return t
+
 
 DEFAULT_SKEW = SkewPolicy()
 
@@ -275,9 +286,7 @@ def _lines_device(triples, n_valid, min_support, *, projections, use_fis,
     avg_load = total_load / jnp.maximum(total_lines, 1).astype(jnp.float32)
     # No cap_pairs backstop here (it is what we are planning); the real pair
     # phase may split a few more lines, which only lowers the normal budget.
-    thresh = jnp.minimum(
-        jnp.maximum(avg_load * skew.factor, jnp.float32(_MIN_SPLIT_LOAD)),
-        jnp.float32(skew.max_load))
+    thresh = skew.split_threshold(avg_load)
     is_giant = valid & (load_f > thresh)
     norm_pairs = jnp.where(valid & ~is_giant, length - 1, 0)
     cap_p = jax.lax.pmax(pairs.saturating_cumsum(norm_pairs)[-1], AXIS)
@@ -329,7 +338,7 @@ _CAP_HOT = 256      # heaviest hot lines reported per device
 _REBALANCE_MIN_GAIN = 0.9  # move only if the planned max drops below 90%
 
 
-def _hotlines_device(jv, n_rows, *, skew=DEFAULT_SKEW):
+def _hotlines_device(jv, n_rows, *, skew=DEFAULT_SKEW, cap_pairs=None):
     """Heaviest above-average lines (jv, length) + base load of this device.
 
     Lines above the giant-split threshold are excluded from both the report
@@ -346,9 +355,7 @@ def _hotlines_device(jv, n_rows, *, skew=DEFAULT_SKEW):
     total_load = jax.lax.psum(jnp.where(is_start, load_f, 0.0).sum(), AXIS)
     total_lines = jax.lax.psum(is_start.sum(), AXIS)
     avg_load = total_load / jnp.maximum(total_lines, 1).astype(jnp.float32)
-    giant_thresh = jnp.minimum(
-        jnp.maximum(avg_load * skew.factor, jnp.float32(_MIN_SPLIT_LOAD)),
-        jnp.float32(skew.max_load))
+    giant_thresh = skew.split_threshold(avg_load, cap_pairs)
     movable = is_start & (load_f <= giant_thresh)
     hot = movable & (load_f > avg_load * _HOT_FACTOR)
     order = jnp.argsort(jnp.where(hot, -load_f, jnp.inf))[:min(_CAP_HOT, n)]
@@ -361,9 +368,9 @@ def _hotlines_device(jv, n_rows, *, skew=DEFAULT_SKEW):
     return hot_jv, hot_len, jnp.full(1, dev_load, jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "skew"))
-def _hotlines_step(jv, n_rows, *, mesh, skew=DEFAULT_SKEW):
-    fn = functools.partial(_hotlines_device, skew=skew)
+@functools.partial(jax.jit, static_argnames=("mesh", "skew", "cap_pairs"))
+def _hotlines_step(jv, n_rows, *, mesh, skew=DEFAULT_SKEW, cap_pairs=None):
+    fn = functools.partial(_hotlines_device, skew=skew, cap_pairs=cap_pairs)
     return jax.shard_map(fn, mesh=mesh, in_specs=(P(AXIS),) * 2,
                          out_specs=P(AXIS), check_vma=False)(jv, n_rows)
 
@@ -462,11 +469,7 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
     total_load = jax.lax.psum(jnp.where(is_start, load_f, 0.0).sum(), AXIS)
     total_lines = jax.lax.psum(is_start.sum(), AXIS)
     avg_load = total_load / jnp.maximum(total_lines, 1).astype(jnp.float32)
-    thresh = jnp.minimum(
-        jnp.minimum(
-            jnp.maximum(avg_load * skew.factor, jnp.float32(_MIN_SPLIT_LOAD)),
-            jnp.float32(skew.max_load)),
-        jnp.float32(cap_pairs // 4))  # absolute backstop
+    thresh = skew.split_threshold(avg_load, cap_pairs)
     is_giant = valid & (load_f > thresh)
     n_giant_lines = jax.lax.psum((is_start & is_giant).sum(), AXIS)
 
@@ -714,7 +717,8 @@ class _Pipeline:
             return
         hot_jv, hot_len, dev_load = _hotlines_step(self.lines[0], self.n_rows,
                                                    mesh=self.mesh,
-                                                   skew=self.skew)
+                                                   skew=self.skew,
+                                                   cap_pairs=self.cap_p)
         hot_jv = np.asarray(hot_jv).reshape(self.num_dev, -1)
         hot_len = np.asarray(hot_len).reshape(self.num_dev, -1)
         cur = np.asarray(dev_load).astype(np.float64)  # (D,) total load

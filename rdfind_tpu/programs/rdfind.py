@@ -52,6 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ar-output", default=None, help="association-rule output file")
     p.add_argument("--collect-result", action="store_true",
                    help="print CINDs to stdout")
+    p.add_argument("--collector", default=None, metavar="HOST:PORT",
+                   help="stream CINDs to a remote collector (JSON lines over "
+                        "TCP; the reference's RMI result channel)")
     p.add_argument("--debug-level", type=int, default=0,
                    help="1: phase timings; 2: + sanity checks (trivial-CIND "
                         "count); 3: + print every CIND")
@@ -74,6 +77,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="halve the 1/1 overlap emission via pair ownership "
                         "(strategy 1, chunked backend)")
     p.add_argument("--rebalance-strategy", type=int, default=1,
+                   choices=(1, 2),
                    help="split-line dependent ownership: 1 = hash-slice, "
                         "2 = contiguous range-slice (sharded runs)")
     p.add_argument("--rebalance-max-load", type=float, default=10000.0 * 10000,
@@ -82,9 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--merge-window-size", type=int, default=-1,
                    help="pair-merge window: max pairs materialized per chunk "
                         "in the chunked backend (-1 = auto)")
+    p.add_argument("--find-only-fcs", type=int, default=0,
+                   help="1: stop after frequent-condition mining (report "
+                        "counts); 2: unary conditions only")
     for flag, dv in (("--rebalance-split", 1), ("--hash-bytes", -1),
-                     ("--frequent-condition-strategy", 0),
-                     ("--find-only-fcs", 0)):
+                     ("--frequent-condition-strategy", 0)):
         p.add_argument(flag, type=int, default=dv, help=argparse.SUPPRESS)
     p.add_argument("--explicit-threshold", type=int, default=-1,
                    help="half-approximate 1/1 round: max exact per-dependent "
@@ -155,6 +161,8 @@ def main(argv=None) -> int:
         rebalance_max_load=args.rebalance_max_load,
         merge_window_size=args.merge_window_size,
         combinable_join=not args.no_combinable_join,
+        collector=args.collector,
+        find_only_fcs=args.find_only_fcs,
     )
     # Un-silence the remaining compatibility no-ops (the reference's
     # JVM-dataflow levers that the TPU design subsumes).
@@ -167,10 +175,17 @@ def main(argv=None) -> int:
              "split lines always fan out to every device in the mesh"),
             ("hash_bytes", "hash dictionary subsumed by exact interning"),
             ("apply_hash", "hash dictionary subsumed by exact interning"),
-            ("hash_dictionary", "hash dictionary subsumed by exact interning")):
+            ("hash_dictionary", "hash dictionary subsumed by exact interning"),
+            ("hash_function", "hash dictionary subsumed by exact interning"),
+            ("find_frequent_captures",
+             "exact capture-support pruning is always on"),
+            ("rebalance_join",
+             "the skew engine is always on for sharded runs; tune it with "
+             "--rebalance-threshold/--rebalance-max-load"),
+            ("only_read_compat", "use --only-read")):
         v = getattr(args, name, None)
         default = {"rebalance_split": 1, "frequent_condition_strategy": 0,
-                   "hash_bytes": -1}.get(name, False)
+                   "hash_bytes": -1, "hash_function": "MD5"}.get(name, False)
         if v not in (default, None):
             print(f"note: --{name.replace('_', '-')} has no effect ({why})",
                   file=sys.stderr)

@@ -64,6 +64,8 @@ class Config:
     rebalance_max_load: float = 10_000.0 * 10_000.0  # absolute split trigger
     merge_window_size: int = -1  # pair-merge window (chunked backend; -1 auto)
     combinable_join: bool = True  # False: ship raw join candidates (ablation)
+    collector: str | None = None  # "host:port" remote result sink (RMI analog)
+    find_only_fcs: int = 0  # >=1: stop after frequent-condition mining
 
 
 @dataclasses.dataclass
@@ -163,6 +165,16 @@ def _checkpoint_fps(cfg: Config, use_native: bool):
         discover_payload)
 
 
+def _skew_from_cfg(cfg: Config) -> "sharded.SkewPolicy":
+    """The one cfg -> SkewPolicy mapping (defaults compare equal to
+    sharded.DEFAULT_SKEW, so 'did the user change anything' is a != check
+    rather than re-spelled default literals)."""
+    return sharded.SkewPolicy(
+        strategy=cfg.rebalance_strategy,
+        factor=sharded.REBALANCE_FACTOR * cfg.rebalance_threshold,
+        max_load=cfg.rebalance_max_load)
+
+
 def _half_approx_active(cfg: Config) -> bool:
     """Whether --explicit-threshold actually selects the half-approximate 1/1
     round: default strategy, single device (the sharded S2L has no
@@ -221,6 +233,8 @@ def describe_plan(cfg: Config) -> dict:
         sinks.append(f"write-output -> {cfg.output_file}")
     if cfg.ar_output_file:
         sinks.append(f"write-ar-output -> {cfg.ar_output_file}")
+    if cfg.collector:
+        sinks.append(f"collect-remote -> {cfg.collector}")
     if cfg.collect_result:
         sinks.append("collect-result (stdout)")
     return {
@@ -327,6 +341,32 @@ def run(cfg: Config) -> RunResult:
         _report(cfg, counters, phases.timings)
         return RunResult(CindTable.empty(), dictionary, ids, counters, phases.timings)
 
+    if cfg.find_only_fcs >= 1:
+        # Stop after the frequent-condition plan (RDFind.scala:298-306):
+        # level >= 2 mines only unary conditions, level 1 also binary (+ ARs).
+        def mine_fcs():
+            n_unary = 0
+            for f in range(3):
+                _, cnts = np.unique(ids[:, f], return_counts=True)
+                n_unary += int((cnts >= cfg.min_support).sum())
+            counters["frequent-single-conditions"] = n_unary
+            if cfg.find_only_fcs < 2:
+                n_binary = 0
+                for a, b in ((0, 1), (0, 2), (1, 2)):
+                    _, cnts = np.unique(ids[:, [a, b]], axis=0,
+                                        return_counts=True)
+                    n_binary += int((cnts >= cfg.min_support).sum())
+                counters["frequent-double-conditions"] = n_binary
+                if cfg.use_association_rules and cfg.use_frequent_item_set:
+                    from ..ops import frequency as freq_ops
+                    rules = freq_ops.mine_association_rules(
+                        ids, cfg.min_support)
+                    counters["association-rules"] = len(rules[0])
+        phases.run("frequent-conditions", mine_fcs)
+        _report(cfg, counters, phases.timings)
+        return RunResult(CindTable.empty(), dictionary, ids, counters,
+                         phases.timings)
+
     use_ars = cfg.use_association_rules and cfg.use_frequent_item_set
     if cfg.use_association_rules and not cfg.use_frequent_item_set:
         # Like the reference: ARs are mined from the frequent-item sets, so without
@@ -347,10 +387,7 @@ def run(cfg: Config) -> RunResult:
             # CINDs, like its single-device form).
             mesh = make_mesh(cfg.n_devices)
             strategy = cfg.traversal_strategy
-            skew = sharded.SkewPolicy(
-                strategy=cfg.rebalance_strategy,
-                factor=sharded.REBALANCE_FACTOR * cfg.rebalance_threshold,
-                max_load=cfg.rebalance_max_load)
+            skew = _skew_from_cfg(cfg)
             if cfg.merge_window_size > 0:
                 print("note: --merge-window-size only affects the "
                       "single-device chunked backend; the sharded run sizes "
@@ -387,8 +424,7 @@ def run(cfg: Config) -> RunResult:
                 projections=cfg.projections,
                 use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
                 clean_implied=cfg.clean_implied, stats=stats)
-        if (cfg.rebalance_strategy != 1 or cfg.rebalance_threshold != 1.0
-                or cfg.rebalance_max_load != 10_000.0 * 10_000.0
+        if (_skew_from_cfg(cfg) != sharded.DEFAULT_SKEW
                 or not cfg.combinable_join):
             print("note: --rebalance-*/--no-combinable-join only affect "
                   "sharded runs (--dop > 1)", file=sys.stderr)
@@ -482,6 +518,23 @@ def run(cfg: Config) -> RunResult:
                     f.write(c.pretty() + "\n")
         phases.run("write-output", write)
 
+    if cfg.collector:
+        # Remote result channel (the reference's RMI collector,
+        # RemoteCollectorUtils.java:38-99, as TCP JSON lines).  A dead
+        # collector must not destroy an otherwise-complete run: the results
+        # are already computed (and possibly written to --output).
+        def send_remote():
+            from .collector import RemoteSink
+            try:
+                with RemoteSink(cfg.collector) as sink:
+                    for c in table.decoded(dictionary):
+                        sink.send_cind(c.pretty())
+            except OSError as e:
+                counters["collector-errors"] = 1
+                print(f"warning: remote collector {cfg.collector} "
+                      f"unreachable ({e}); results NOT streamed",
+                      file=sys.stderr)
+        phases.run("collect-remote", send_remote)
     if cfg.collect_result or cfg.debug_level >= 3:
         for c in table.decoded(dictionary):
             print(c.pretty())

@@ -1,0 +1,69 @@
+"""Remote result channel (TCP JSON lines; the reference's RMI collector analog)."""
+
+import threading
+
+import pytest
+
+from rdfind_tpu.runtime import driver
+from rdfind_tpu.runtime.collector import CollectorServer, RemoteSink
+
+
+def test_roundtrip():
+    got = []
+    done = threading.Event()
+
+    def consume(rec):
+        got.append(rec)
+        if rec.get("kind") == "end":
+            done.set()
+
+    with CollectorServer(consume) as srv:
+        host, port = srv.addr
+        with RemoteSink(f"{host}:{port}") as sink:
+            sink.send_cind("a < b (2)")
+            sink.send_cind("c < d (3)")
+        assert done.wait(5)
+    kinds = [r["kind"] for r in got]
+    assert kinds == ["cind", "cind", "end"]
+    assert got[-1]["count"] == 2
+    assert got[0]["text"] == "a < b (2)"
+
+
+def test_driver_streams_results(tmp_path):
+    nt = tmp_path / "d.nt"
+    nt.write_text("<s1> <p1> <o1> .\n<s2> <p1> <o1> .\n"
+                  "<s1> <p2> <o1> .\n<s2> <p2> <o1> .\n")
+    got = []
+    done = threading.Event()
+
+    def consume(rec):
+        got.append(rec)
+        if rec.get("kind") == "end":
+            done.set()
+
+    with CollectorServer(consume) as srv:
+        host, port = srv.addr
+        res = driver.run(driver.Config(
+            input_paths=[str(nt)], min_support=1, traversal_strategy=0,
+            collector=f"{host}:{port}"))
+        assert done.wait(10)
+    end = got[-1]
+    assert end["kind"] == "end" and end["count"] == len(res.table)
+    texts = sorted(r["text"] for r in got if r["kind"] == "cind")
+    assert texts == sorted(c.pretty() for c in res.decoded())
+    assert "collect-remote" in res.timings
+
+
+def test_sink_connection_refused():
+    with pytest.raises(OSError):
+        RemoteSink("127.0.0.1:1", timeout=0.5)  # nothing listens on port 1
+
+
+def test_driver_survives_dead_collector(tmp_path, capsys):
+    nt = tmp_path / "d.nt"
+    nt.write_text("<s1> <p1> <o1> .\n<s2> <p1> <o1> .\n")
+    res = driver.run(driver.Config(
+        input_paths=[str(nt)], min_support=1, traversal_strategy=0,
+        collector="127.0.0.1:1"))  # nothing listens there
+    assert res.counters.get("collector-errors") == 1
+    assert len(res.table) > 0  # results survived the dead sink
