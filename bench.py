@@ -117,6 +117,27 @@ def _run(n: int, min_support: int) -> dict:
         "oracle_pairs_per_sec": round(oracle_pairs_per_sec, 1),
     }
 
+    # The DEFAULT strategy (SmallToLarge, id 1) on the same workload, so the
+    # default path always has a recorded number too (best-effort).
+    try:
+        from rdfind_tpu.models import small_to_large
+        s2l_stats: dict = {}
+        small_to_large.discover(triples, min_support, stats=s2l_stats)  # warm
+        s2l_stats.clear()
+        t0 = time.perf_counter()
+        s2l_table = small_to_large.discover(triples, min_support,
+                                            stats=s2l_stats)
+        s2l_wall = time.perf_counter() - t0
+        detail["s2l"] = {
+            "wall_s": round(s2l_wall, 3),
+            "total_pairs": int(s2l_stats.get("total_pairs", 0)),
+            "pairs_per_sec": round(
+                s2l_stats.get("total_pairs", 0) / s2l_wall, 1),
+            "cinds": len(s2l_table),
+        }
+    except Exception as e:
+        detail["s2l"] = {"error": f"{type(e).__name__}: {e}"}
+
     # Pallas packed-bitset kernel vs jnp planes path, on this backend.
     try:
         from rdfind_tpu.ops import sketch

@@ -86,6 +86,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--merge-window-size", type=int, default=-1,
                    help="pair-merge window: max pairs materialized per chunk "
                         "in the chunked backend (-1 = auto)")
+    p.add_argument("--create-join-histogram", action="store_true",
+                   help="print a join-line size histogram "
+                        "('Join size N encountered Mx')")
     p.add_argument("--find-only-fcs", type=int, default=0,
                    help="1: stop after frequent-condition mining (report "
                         "counts); 2: unary conditions only")
@@ -117,7 +120,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not args.projection or not set(args.projection) <= set("spo"):
+        # Otherwise typo'd fields are silently dropped (zero or partial
+        # output) — a long-standing footgun.
+        parser.error(f"--projection {args.projection!r} must be a non-empty "
+                     f"subset of 'spo'")
     if args.dop > 1 and "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
         # Allow --dop on CPU-only hosts (the minicluster analog): request fake
@@ -163,6 +172,7 @@ def main(argv=None) -> int:
         combinable_join=not args.no_combinable_join,
         collector=args.collector,
         find_only_fcs=args.find_only_fcs,
+        create_join_histogram=args.create_join_histogram,
     )
     # Un-silence the remaining compatibility no-ops (the reference's
     # JVM-dataflow levers that the TPU design subsumes).

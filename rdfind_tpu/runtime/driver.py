@@ -66,6 +66,7 @@ class Config:
     combinable_join: bool = True  # False: ship raw join candidates (ablation)
     collector: str | None = None  # "host:port" remote result sink (RMI analog)
     find_only_fcs: int = 0  # >=1: stop after frequent-condition mining
+    create_join_histogram: bool = False  # print join-line size histogram
 
 
 @dataclasses.dataclass
@@ -163,6 +164,31 @@ def _checkpoint_fps(cfg: Config, use_native: bool):
     # balanced_11 is output-neutral, so it never enters the fingerprint.
     return checkpoint.fingerprint(ingest_payload), checkpoint.fingerprint(
         discover_payload)
+
+
+def _join_histogram(ids: np.ndarray, projections: str):
+    """(line_size, occurrence_count) pairs over the unfiltered join, using the
+    same device emission as the real pipelines."""
+    import jax.numpy as jnp
+
+    from ..ops import frequency, segments
+    from ..ops.emission import emit_join_candidates
+
+    n = ids.shape[0]
+    if n == 0:
+        return []
+    cap = segments.pow2_capacity(n)
+    padded = np.pad(np.asarray(ids, np.int32), ((0, cap - n), (0, 0)),
+                    constant_values=np.iinfo(np.int32).max)
+    t = jnp.asarray(padded)
+    valid = jnp.arange(cap, dtype=jnp.int32) < n
+    cands = emit_join_candidates(t, frequency.no_filter(valid), projections)
+    cols, v, _, n_rows = segments.masked_unique(
+        [cands.join_val, cands.code, cands.v1, cands.v2], cands.valid)
+    jv = np.asarray(cols[0])[: int(n_rows)]
+    _, line_sizes = np.unique(jv, return_counts=True)
+    sizes, times = np.unique(line_sizes, return_counts=True)
+    return list(zip(sizes.tolist(), times.tolist()))
 
 
 def _skew_from_cfg(cfg: Config) -> "sharded.SkewPolicy":
@@ -337,6 +363,15 @@ def run(cfg: Config) -> RunResult:
             phases.run("checkpoint-ingest", save_ingest)
     counters["distinct-values"] = len(dictionary)
 
+    if cfg.create_join_histogram:
+        # Join-line size histogram (RDFind.scala:448-452): an extra pass over
+        # the join, exactly like the reference's extra map/groupBy/collect
+        # job.  Runs before the --do-only-join return, as in the reference.
+        def histogram():
+            for size, times in _join_histogram(ids, cfg.projections):
+                print(f"Join size {size} encountered {times}x")
+        phases.run("join-histogram", histogram)
+
     if cfg.only_join:
         _report(cfg, counters, phases.timings)
         return RunResult(CindTable.empty(), dictionary, ids, counters, phases.timings)
@@ -424,8 +459,13 @@ def run(cfg: Config) -> RunResult:
                 projections=cfg.projections,
                 use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
                 clean_implied=cfg.clean_implied, stats=stats)
-        if (_skew_from_cfg(cfg) != sharded.DEFAULT_SKEW
-                or not cfg.combinable_join):
+        try:
+            skew_nondefault = _skew_from_cfg(cfg) != sharded.DEFAULT_SKEW
+        except ValueError:
+            # Invalid values are also "non-default"; single-device runs only
+            # note them (they never reach the skew engine).
+            skew_nondefault = True
+        if skew_nondefault or not cfg.combinable_join:
             print("note: --rebalance-*/--no-combinable-join only affect "
                   "sharded runs (--dop > 1)", file=sys.stderr)
         # Strategy dispatch (TraversalStrategy registry, RDFind.scala:50-56).
@@ -529,7 +569,9 @@ def run(cfg: Config) -> RunResult:
                 with RemoteSink(cfg.collector) as sink:
                     for c in table.decoded(dictionary):
                         sink.send_cind(c.pretty())
-            except OSError as e:
+            except (OSError, ValueError) as e:
+                # ValueError: malformed host:port — same contract: a bad
+                # collector must not destroy a completed run.
                 counters["collector-errors"] = 1
                 print(f"warning: remote collector {cfg.collector} "
                       f"unreachable ({e}); results NOT streamed",

@@ -67,3 +67,13 @@ def test_driver_survives_dead_collector(tmp_path, capsys):
         collector="127.0.0.1:1"))  # nothing listens there
     assert res.counters.get("collector-errors") == 1
     assert len(res.table) > 0  # results survived the dead sink
+
+
+def test_driver_survives_malformed_collector(tmp_path):
+    nt = tmp_path / "d.nt"
+    nt.write_text("<s1> <p1> <o1> .\n<s2> <p1> <o1> .\n")
+    res = driver.run(driver.Config(
+        input_paths=[str(nt)], min_support=1, traversal_strategy=0,
+        collector="localhost"))  # port forgotten
+    assert res.counters.get("collector-errors") == 1
+    assert len(res.table) > 0

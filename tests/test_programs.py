@@ -191,3 +191,51 @@ def test_rdfind_find_only_fcs(fixture_file, capsys):
     _, err = capsys.readouterr()
     assert "frequent-single-conditions: 7" in err
     assert "frequent-double-conditions" not in err
+
+
+def test_rdfind_join_histogram(fixture_file, capsys):
+    """--create-join-histogram prints the reference's 'Join size N encountered
+    Mx' lines, consistent with the joinline oracle's line sizes."""
+    rc = rdfind.main([fixture_file, "--support", "1",
+                      "--create-join-histogram"])
+    assert rc == 0
+    out, _ = capsys.readouterr()
+    lines = [l for l in out.splitlines() if l.startswith("Join size")]
+    assert lines, out
+    # Cross-check against a hand-rolled dict-of-sets join construction.
+    import collections
+    import re
+
+    from rdfind_tpu.io import ntriples, reader
+    triples = [ntriples.parse_line(l)
+               for _, l in reader.iter_lines([fixture_file])]
+    triples = [t for t in triples if t is not None]
+    jls = collections.defaultdict(set)
+    for t in triples:
+        for pi in range(3):  # projections = "spo"
+            a, b = [i for i in range(3) if i != pi]
+            jls[t[pi]].add(("u", pi, a, t[a]))
+            jls[t[pi]].add(("u", pi, b, t[b]))
+            jls[t[pi]].add(("b", pi, t[a], t[b]))
+    want = collections.Counter(len(v) for v in jls.values())
+    got = {}
+    for l in lines:
+        m = re.match(r"Join size (\d+) encountered (\d+)x", l)
+        got[int(m.group(1))] = int(m.group(2))
+    assert got == dict(want)
+
+
+def test_rdfind_rejects_empty_projection(fixture_file, capsys):
+    with pytest.raises(SystemExit):
+        rdfind.main([fixture_file, "--projection", "sp9"])
+    _, err = capsys.readouterr()
+    assert "subset of 'spo'" in err
+
+
+def test_rdfind_histogram_with_only_join(fixture_file, capsys):
+    """Histogram runs before the --do-only-join early return (ref order)."""
+    rc = rdfind.main([fixture_file, "--support", "1", "--do-only-join",
+                      "--create-join-histogram"])
+    assert rc == 0
+    out, _ = capsys.readouterr()
+    assert any(l.startswith("Join size") for l in out.splitlines())
