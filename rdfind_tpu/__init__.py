@@ -17,3 +17,23 @@ Package layout:
 """
 
 __version__ = "0.1.0"
+
+
+def discover(triples, min_support: int = 10, strategy: int = 1, **kwargs):
+    """One-call CIND discovery over an (N, 3) int32 id-triple table.
+
+    ``strategy`` follows the reference's ids (RDFind.scala:50-56):
+    0 = all-at-once, 1 = small-to-large (default), 2 = approximate
+    all-at-once, 3 = late-BB.  Extra kwargs go to the strategy (e.g.
+    ``projections=``, ``stats=``, ``clean_implied=``).  Returns a
+    ``data.CindTable``.  For file ingest, CLI flags, checkpointing, and
+    multi-device meshes use ``runtime.driver.run`` / the ``programs.rdfind``
+    CLI.
+    """
+    from .runtime.driver import STRATEGIES
+
+    fn = STRATEGIES.get(strategy)
+    if fn is None:
+        raise ValueError(f"unknown traversal strategy {strategy}; "
+                         f"expected one of {sorted(STRATEGIES)}")
+    return fn(triples, min_support, **kwargs)

@@ -810,7 +810,25 @@ class _Pipeline:
 
     def capture_table(self):
         """Host capture table in canonical (code, v1, v2) order.  Each distinct
-        capture lives on exactly one device (hash-routed): no duplicates."""
+        capture lives on exactly one device (hash-routed): no duplicates.
+
+        Size budget: the S2L lattice generation is host-side numpy over this
+        table (like the reference's driver-side plan construction), so the
+        table must fit one host.  At 4x int64 per capture, the default
+        budget of 2^27 captures is ~4 GiB of host RAM — far above any
+        frequent-capture table a single v5e chip's HBM-resident join could
+        have produced, but a real guard at the DBpedia-scale configs
+        (BASELINE.json 3-4), which need sharded lattice generation, not a
+        bigger host pull.  RDFIND_HOST_CAPTURES_BUDGET overrides.
+        """
+        total = int(np.asarray(self.n_caps).sum())
+        budget = int(os.environ.get("RDFIND_HOST_CAPTURES_BUDGET", 1 << 27))
+        if total > budget:
+            raise ValueError(
+                f"capture table ({total} captures) exceeds the host-side "
+                f"lattice budget ({budget}); raise "
+                f"RDFIND_HOST_CAPTURES_BUDGET or use strategy 0 "
+                f"(fully device-resident)")
         tc, tv1, tv2, tcnt = self.collect_blocks(self.tbl, self.n_caps)
         cap_code = tc.astype(np.int64)
         cap_v1 = tv1.astype(np.int64)

@@ -461,13 +461,15 @@ def run(cfg: Config) -> RunResult:
                 clean_implied=cfg.clean_implied, stats=stats)
         try:
             skew_nondefault = _skew_from_cfg(cfg) != sharded.DEFAULT_SKEW
-        except ValueError:
-            # Invalid values are also "non-default"; single-device runs only
-            # note them (they never reach the skew engine).
-            skew_nondefault = True
-        if skew_nondefault or not cfg.combinable_join:
-            print("note: --rebalance-*/--no-combinable-join only affect "
-                  "sharded runs (--dop > 1)", file=sys.stderr)
+            if skew_nondefault or not cfg.combinable_join:
+                print("note: --rebalance-*/--no-combinable-join only affect "
+                      "sharded runs (--dop > 1)", file=sys.stderr)
+        except ValueError as e:
+            # Invalid values never reach the skew engine on a single device,
+            # but a --dop > 1 rerun would reject them — say so.
+            print(f"note: invalid rebalance settings ignored on this "
+                  f"single-device run; a sharded run (--dop > 1) would "
+                  f"reject them ({e})", file=sys.stderr)
         # Strategy dispatch (TraversalStrategy registry, RDFind.scala:50-56).
         strategy = STRATEGIES.get(cfg.traversal_strategy)
         if strategy is None:
@@ -566,15 +568,25 @@ def run(cfg: Config) -> RunResult:
         def send_remote():
             from .collector import RemoteSink
             try:
-                with RemoteSink(cfg.collector) as sink:
-                    for c in table.decoded(dictionary):
-                        sink.send_cind(c.pretty())
+                # ValueError here == malformed host:port.  Only the sink
+                # construction is shielded so a decoding bug in the results
+                # themselves still fails loudly instead of masquerading as a
+                # networking warning.
+                sink = RemoteSink(cfg.collector)
             except (OSError, ValueError) as e:
-                # ValueError: malformed host:port — same contract: a bad
-                # collector must not destroy a completed run.
                 counters["collector-errors"] = 1
                 print(f"warning: remote collector {cfg.collector} "
                       f"unreachable ({e}); results NOT streamed",
+                      file=sys.stderr)
+                return
+            try:
+                with sink:
+                    for c in table.decoded(dictionary):
+                        sink.send_cind(c.pretty())
+            except OSError as e:  # stream dropped mid-send
+                counters["collector-errors"] = 1
+                print(f"warning: remote collector {cfg.collector} dropped "
+                      f"the stream ({e}); results may be truncated",
                       file=sys.stderr)
         phases.run("collect-remote", send_remote)
     if cfg.collect_result or cfg.debug_level >= 3:

@@ -239,3 +239,16 @@ def test_rdfind_histogram_with_only_join(fixture_file, capsys):
     assert rc == 0
     out, _ = capsys.readouterr()
     assert any(l.startswith("Join size") for l in out.splitlines())
+
+
+def test_package_discover_api():
+    import numpy as np
+
+    import rdfind_tpu
+    ids = np.asarray([[0, 10, 20], [1, 10, 20], [0, 11, 20], [1, 11, 20]],
+                     np.int32)
+    for strat in (0, 1, 2, 3):
+        t = rdfind_tpu.discover(ids, 2, strategy=strat)
+        assert len(t) > 0
+    with pytest.raises(ValueError, match="unknown traversal strategy"):
+        rdfind_tpu.discover(ids, 2, strategy=9)

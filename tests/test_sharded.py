@@ -408,3 +408,12 @@ def test_no_combinable_join(mesh8):
 def test_skew_policy_validation():
     with pytest.raises(ValueError, match="rebalance strategy"):
         sharded.SkewPolicy(strategy=3)
+
+
+def test_host_capture_budget_guard(mesh8, monkeypatch):
+    """The host-side lattice pull fails loudly past its stated budget."""
+    import os
+    monkeypatch.setitem(os.environ, "RDFIND_HOST_CAPTURES_BUDGET", "4")
+    triples = generate_triples(100, seed=2, n_predicates=4, n_entities=16)
+    with pytest.raises(ValueError, match="lattice budget"):
+        sharded.discover_sharded_s2l(triples, 2, mesh=mesh8)
