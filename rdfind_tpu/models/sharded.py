@@ -81,7 +81,7 @@ from ..data import CindTable
 from ..ops import frequency, hashing, pairs, segments
 from ..ops.emission import emit_join_candidates
 from ..parallel import exchange
-from ..parallel.mesh import AXIS, make_mesh
+from ..parallel.mesh import AXIS, host_gather, make_global, make_mesh
 
 SENTINEL = segments.SENTINEL
 
@@ -648,15 +648,15 @@ class _Pipeline:
         self.skew = skew if skew is not None else DEFAULT_SKEW
         self.combine = combine
         padded, n_valid, _ = _shard_triples(triples, self.num_dev)
-        self._triples = jnp.asarray(padded)
-        self._n_valid = jnp.asarray(n_valid)
+        self._triples = make_global(padded, mesh)
+        self._n_valid = make_global(n_valid, mesh)
 
         # P1: measured plan for the pre-exchange capacities.
         cap_f, cap_a = _plan_step(self._triples, self._n_valid, mesh=mesh,
                                   projections=projections, use_fis=use_fis,
                                   combine=combine)
-        self.cap_f = _headroom(np.asarray(cap_f)[0]) if use_fis else 1
-        self.cap_a = _headroom(np.asarray(cap_a)[0])
+        self.cap_f = _headroom(host_gather(cap_f)[0]) if use_fis else 1
+        self.cap_a = _headroom(host_gather(cap_a)[0])
 
         # P2: lines + downstream load measurement (retry on freq/A overflow).
         for _ in range(max_retries):
@@ -666,7 +666,7 @@ class _Pipeline:
                 use_ars=use_ars, cap_freq=self.cap_f, cap_exchange_a=self.cap_a,
                 skew=self.skew, combine=self.combine)
             *line_cols, n_rows, plan, overflow = out
-            ovf = np.asarray(overflow).reshape(self.num_dev, 2)[0]
+            ovf = host_gather(overflow).reshape(self.num_dev, 2)[0]
             if int(ovf.sum()) == 0:
                 break
             if ovf[0] > 0:
@@ -679,7 +679,7 @@ class _Pipeline:
                 f"(freq={int(ovf[0])}, exchange_a={int(ovf[1])})")
         self.lines = line_cols  # jv, code, v1, v2 — device-resident
         self.n_rows = n_rows
-        plan = np.asarray(plan).reshape(self.num_dev, 4)[0]
+        plan = host_gather(plan).reshape(self.num_dev, 4)[0]
         self.cap_b = _headroom(plan[0])
         self.cap_p = _headroom(plan[1], floor=1 << 10)
         self.cap_g = _headroom(plan[2])
@@ -694,7 +694,7 @@ class _Pipeline:
             out = _captures_step(*self.lines, self.n_rows, mesh=mesh,
                                  cap_exchange_b=self.cap_b)
             *tbl, n_caps, ovf_b = out
-            ovf_b = int(np.asarray(ovf_b)[0])
+            ovf_b = int(host_gather(ovf_b)[0])
             if ovf_b == 0:
                 break
             self.cap_b = segments.pow2_capacity(2 * self.cap_b + ovf_b)
@@ -719,9 +719,9 @@ class _Pipeline:
                                                    mesh=self.mesh,
                                                    skew=self.skew,
                                                    cap_pairs=self.cap_p)
-        hot_jv = np.asarray(hot_jv).reshape(self.num_dev, -1)
-        hot_len = np.asarray(hot_len).reshape(self.num_dev, -1)
-        cur = np.asarray(dev_load).astype(np.float64)  # (D,) total load
+        hot_jv = host_gather(hot_jv).reshape(self.num_dev, -1)
+        hot_len = host_gather(hot_len).reshape(self.num_dev, -1)
+        cur = host_gather(dev_load).astype(np.float64)  # (D,) total load
         mask = hot_jv != int(SENTINEL)
         if not mask.any():
             return
@@ -768,11 +768,10 @@ class _Pipeline:
         moved_dest[:len(mj)] = md
         for _ in range(self.max_retries):
             out = _rebalance_step(*self.lines, self.n_rows,
-                                  jnp.asarray(moved_jv),
-                                  jnp.asarray(moved_dest),
+                                  moved_jv, moved_dest,
                                   mesh=self.mesh, cap_move=cap_move)
             *cols, n_rows, ovf = out
-            ovf = int(np.asarray(ovf)[0])
+            ovf = int(host_gather(ovf)[0])
             if ovf == 0:
                 break
             cap_move = segments.pow2_capacity(2 * cap_move + ovf)
@@ -800,8 +799,8 @@ class _Pipeline:
 
     def collect_blocks(self, cols, n_out):
         """Per-device compacted outputs -> host rows."""
-        cols = [np.asarray(c) for c in cols]
-        n_out = np.asarray(n_out)
+        cols = [host_gather(c) for c in cols]
+        n_out = host_gather(n_out)
         block = cols[0].shape[0] // self.num_dev
         keep = np.zeros(cols[0].shape[0], bool)
         for dev in range(self.num_dev):
@@ -821,7 +820,7 @@ class _Pipeline:
         (BASELINE.json 3-4), which need sharded lattice generation, not a
         bigger host pull.  RDFIND_HOST_CAPTURES_BUDGET overrides.
         """
-        total = int(np.asarray(self.n_caps).sum())
+        total = int(host_gather(self.n_caps).sum())
         budget = int(os.environ.get("RDFIND_HOST_CAPTURES_BUDGET", 1 << 27))
         if total > budget:
             raise ValueError(
@@ -844,7 +843,7 @@ class _Pipeline:
                              jnp.int32(self.min_support), mesh=self.mesh,
                              **self._pair_caps())
             *cols, n_out, overflow, ngl, ngp = out
-            ovf = np.asarray(overflow).reshape(self.num_dev, 4)[0]
+            ovf = host_gather(overflow).reshape(self.num_dev, 4)[0]
             if int(ovf.sum()) == 0:
                 break
             self._grow_pair_caps(ovf)
@@ -853,8 +852,8 @@ class _Pipeline:
                 f"pair-phase overflow persisted after {self.max_retries} "
                 f"retries ({ovf.tolist()})")
         if self.stats is not None:
-            self.stats["n_giant_lines"] = int(np.asarray(ngl)[0])
-            self.stats["n_giant_pairs"] = int(np.asarray(ngp)[0])
+            self.stats["n_giant_lines"] = int(host_gather(ngl)[0])
+            self.stats["n_giant_pairs"] = int(host_gather(ngp)[0])
         return self.collect_blocks(cols, n_out)
 
     def run_cooc(self, fcode, fv1, fv2, fflag, n_flags, stat_key):
@@ -863,7 +862,7 @@ class _Pipeline:
             out = _s2l_cooc(*self.lines, self.n_rows, fcode, fv1, fv2, fflag,
                             n_flags, mesh=self.mesh, **self._pair_caps())
             *cols, n_out, overflow, ngl, ngp, npt = out
-            ovf = np.asarray(overflow).reshape(self.num_dev, 4)[0]
+            ovf = host_gather(overflow).reshape(self.num_dev, 4)[0]
             if int(ovf.sum()) == 0:
                 break
             self._grow_pair_caps(ovf)
@@ -872,13 +871,13 @@ class _Pipeline:
                 f"sharded S2L cooc overflow persisted after "
                 f"{self.max_retries} retries ({ovf.tolist()})")
         if self.stats is not None:
-            npt = int(np.asarray(npt)[0])
+            npt = int(host_gather(npt)[0])
             self.stats[stat_key] = npt
             self.stats["total_pairs"] = self.stats.get("total_pairs", 0) + npt
             self.stats["n_giant_lines"] = max(
-                self.stats.get("n_giant_lines", 0), int(np.asarray(ngl)[0]))
+                self.stats.get("n_giant_lines", 0), int(host_gather(ngl)[0]))
             self.stats["n_giant_pairs"] = (
-                self.stats.get("n_giant_pairs", 0) + int(np.asarray(ngp)[0]))
+                self.stats.get("n_giant_pairs", 0) + int(host_gather(ngp)[0]))
         return self.collect_blocks(cols, n_out)
 
 
@@ -998,11 +997,11 @@ class _ShardedCooc:
         cap_f = segments.pow2_capacity(sel.size)
         pad = lambda a, fill: np.concatenate(
             [a, np.full(cap_f - a.shape[0], fill, a.dtype)])
-        fcode = jnp.asarray(pad(self.cap_code[sel].astype(np.int32), SENTINEL))
-        fv1 = jnp.asarray(pad(self.cap_v1[sel].astype(np.int32), SENTINEL))
-        fv2 = jnp.asarray(pad(self.cap_v2[sel].astype(np.int32), SENTINEL))
-        fflag = jnp.asarray(pad(flag, 0))
-        n_flags = jnp.full(1, sel.size, jnp.int32)
+        fcode = pad(self.cap_code[sel].astype(np.int32), SENTINEL)
+        fv1 = pad(self.cap_v1[sel].astype(np.int32), SENTINEL)
+        fv2 = pad(self.cap_v2[sel].astype(np.int32), SENTINEL)
+        fflag = pad(flag, 0)
+        n_flags = np.full(1, sel.size, np.int32)
 
         d_code, d_v1, d_v2, r_code, r_v1, r_v2, cnt = self.pipe.run_cooc(
             fcode, fv1, fv2, fflag, n_flags, stat_key)
@@ -1109,10 +1108,10 @@ def _sharded_sketch_candidates(pipe, cap_table, bits, num_hashes, stats):
         [a.astype(np.int32), np.full(c_pad - num_caps, SENTINEL, np.int32)])
     packed = _sketch_step(
         *pipe.lines, pipe.n_rows,
-        jnp.asarray(pad(cap_code)), jnp.asarray(pad(cap_v1)),
-        jnp.asarray(pad(cap_v2)), jnp.full(1, num_caps, jnp.int32),
+        pad(cap_code), pad(cap_v1),
+        pad(cap_v2), np.full(1, num_caps, np.int32),
         mesh=pipe.mesh, c_pad=c_pad, bits=bits, num_hashes=num_hashes)
-    bits_h = cooc_ops.unpack_cind_bits(np.asarray(packed), c_pad)
+    bits_h = cooc_ops.unpack_cind_bits(host_gather(packed), c_pad)
     d, r = np.nonzero(bits_h[:num_caps, :num_caps])
     if stats is not None:
         stats["n_sketch_candidates"] = int(d.size)

@@ -63,6 +63,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--counters", type=int, default=0, dest="counter_level")
     p.add_argument("--dop", type=int, default=1,
                    help="degree of parallelism = number of devices in the mesh")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="multi-host run: process 0's coordinator address "
+                        "(every host runs the same command with its own "
+                        "--host-index)")
+    p.add_argument("--num-hosts", type=int, default=1,
+                   help="multi-host run: total number of host processes")
+    p.add_argument("--host-index", type=int, default=0,
+                   help="multi-host run: this process's index in [0, "
+                        "num-hosts)")
     # Accepted-for-compatibility (behavior built-in or subsumed; a note is
     # printed when set so no flag is a *silent* no-op):
     for flag in ("--find-frequent-captures", "--no-bulk-merge",
@@ -127,7 +136,8 @@ def main(argv=None) -> int:
         # output) — a long-standing footgun.
         parser.error(f"--projection {args.projection!r} must be a non-empty "
                      f"subset of 'spo'")
-    if args.dop > 1 and "xla_force_host_platform_device_count" not in \
+    if args.dop > 1 and args.coordinator is None and \
+            "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
         # Allow --dop on CPU-only hosts (the minicluster analog): request fake
         # host devices before the JAX backend initializes.  No effect if a real
@@ -135,6 +145,27 @@ def main(argv=None) -> int:
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                    f" --xla_force_host_platform_device_count={args.dop}"
                                    ).strip()
+    if args.coordinator is None and (args.num_hosts != 1
+                                     or args.host_index != 0):
+        parser.error("--num-hosts/--host-index require --coordinator "
+                     "(without it this would run a full independent "
+                     "single-host job)")
+    if args.coordinator:
+        # Join the multi-host runtime before anything touches the backend;
+        # the mesh then spans every host's devices and --dop defaults to all
+        # of them.
+        from ..parallel.mesh import initialize_multihost
+        initialize_multihost(args.coordinator, args.num_hosts, args.host_index)
+        import jax
+        if args.dop == 1:
+            args.dop = jax.device_count()
+        elif args.dop != jax.device_count():
+            # A mesh over a device subset would exclude whole processes and
+            # deadlock the collectives.
+            parser.error(
+                f"--dop {args.dop} does not span the multi-host runtime "
+                f"({jax.device_count()} devices across {args.num_hosts} "
+                f"hosts); omit --dop or pass the global device count")
     from ..runtime import driver  # deferred: must follow XLA_FLAGS setup
 
     cfg = driver.Config(

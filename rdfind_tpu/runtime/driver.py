@@ -191,6 +191,16 @@ def _join_histogram(ids: np.ndarray, projections: str):
     return list(zip(sizes.tolist(), times.tolist()))
 
 
+
+def _is_primary() -> bool:
+    """True on the host that owns sinks/reports (process 0; SPMD convention:
+    every host computes, one host writes)."""
+    import jax
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
 def _skew_from_cfg(cfg: Config) -> "sharded.SkewPolicy":
     """The one cfg -> SkewPolicy mapping (defaults compare equal to
     sharded.DEFAULT_SKEW, so 'did the user change anything' is a != check
@@ -304,7 +314,7 @@ def run(cfg: Config) -> RunResult:
     phases = _Phases()
     counters: dict = {}
 
-    if cfg.print_plan:
+    if cfg.print_plan and _is_primary():
         import json as _json
         print(_json.dumps(describe_plan(cfg), indent=2))
 
@@ -368,8 +378,10 @@ def run(cfg: Config) -> RunResult:
         # the join, exactly like the reference's extra map/groupBy/collect
         # job.  Runs before the --do-only-join return, as in the reference.
         def histogram():
-            for size, times in _join_histogram(ids, cfg.projections):
-                print(f"Join size {size} encountered {times}x")
+            hist = _join_histogram(ids, cfg.projections)
+            if _is_primary():
+                for size, times in hist:
+                    print(f"Join size {size} encountered {times}x")
         phases.run("join-histogram", histogram)
 
     if cfg.only_join:
@@ -534,7 +546,7 @@ def run(cfg: Config) -> RunResult:
         # mine rules from (RDFind.scala:290-296) -- write nothing.
         print("note: --ar-output requires --use-fis; no rules written",
               file=sys.stderr)
-    if cfg.ar_output_file and cfg.use_frequent_item_set:
+    if cfg.ar_output_file and cfg.use_frequent_item_set and _is_primary():
         def write_ars():
             mined = stats.get("association_rules")
             if mined is None:
@@ -552,7 +564,7 @@ def run(cfg: Config) -> RunResult:
                             f"confidence=100.00%)\n")
         phases.run("write-ar-output", write_ars)
 
-    if cfg.output_file:
+    if cfg.output_file and _is_primary():
         def write():
             cinds = table.decoded(dictionary)
             with open(cfg.output_file, "w") as f:
@@ -560,7 +572,7 @@ def run(cfg: Config) -> RunResult:
                     f.write(c.pretty() + "\n")
         phases.run("write-output", write)
 
-    if cfg.collector:
+    if cfg.collector and _is_primary():
         # Remote result channel (the reference's RMI collector,
         # RemoteCollectorUtils.java:38-99, as TCP JSON lines).  A dead
         # collector must not destroy an otherwise-complete run: the results
@@ -589,7 +601,7 @@ def run(cfg: Config) -> RunResult:
                       f"the stream ({e}); results may be truncated",
                       file=sys.stderr)
         phases.run("collect-remote", send_remote)
-    if cfg.collect_result or cfg.debug_level >= 3:
+    if (cfg.collect_result or cfg.debug_level >= 3) and _is_primary():
         for c in table.decoded(dictionary):
             print(c.pretty())
 
@@ -599,6 +611,8 @@ def run(cfg: Config) -> RunResult:
 
 def _report(cfg: Config, counters: dict, timings: dict) -> None:
     """Post-run statistics, incl. the CSV line (AbstractFlinkProgram.java:149-182)."""
+    if not _is_primary():
+        return
     if cfg.counter_level >= 1:
         for k, v in sorted(counters.items()):
             print(f"{k}: {v}", file=sys.stderr)

@@ -1,0 +1,40 @@
+"""Worker for the multi-host test: one JAX process in a 2-process CPU run.
+
+Runs sharded discovery over the global 8-device mesh (4 local devices per
+process, cross-process collectives over TCP — the DCN analog) and, on process
+0, prints the result rows as JSON for the parent test to compare.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    strategy = sys.argv[4] if len(sys.argv) > 4 else "0"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from rdfind_tpu.models import sharded
+    from rdfind_tpu.parallel import mesh as mesh_mod
+    from rdfind_tpu.utils.synth import generate_triples
+
+    mesh_mod.initialize_multihost(f"127.0.0.1:{port}", nproc, pid)
+    assert jax.device_count() == 4 * nproc
+    mesh = mesh_mod.make_mesh()
+    triples = generate_triples(200, seed=3, n_predicates=6, n_entities=24)
+    fn = {"0": sharded.discover_sharded,
+          "1": sharded.discover_sharded_s2l}[strategy]
+    table = fn(triples, 2, mesh=mesh)
+    if pid == 0:
+        print("ROWS " + json.dumps(sorted(table.to_rows())), flush=True)
+
+
+if __name__ == "__main__":
+    main()
