@@ -35,7 +35,9 @@ def initialize_multihost(coordinator: str, num_processes: int,
     other jax API touches the backend.
     """
     global _MULTIHOST_INITIALIZED
-    if _MULTIHOST_INITIALIZED or jax.process_count() > 1:
+    # NB: probing via jax.process_count() would itself initialize the XLA
+    # backend and make initialize() illegal — use the distributed-state API.
+    if _MULTIHOST_INITIALIZED or jax.distributed.is_initialized():
         return  # already joined (jax.distributed.initialize is once-only)
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
