@@ -23,21 +23,6 @@ import time
 import numpy as np
 
 
-def _cind_family_counts(table):
-    from rdfind_tpu import conditions as cc
-
-    dep = np.asarray(table.dep_code)
-    ref = np.asarray(table.ref_code)
-    dep_u = cc.is_unary(dep)
-    ref_u = cc.is_unary(ref)
-    return {
-        "11": int((dep_u & ref_u).sum()),
-        "12": int((dep_u & ~ref_u).sum()),
-        "21": int((~dep_u & ref_u).sum()),
-        "22": int((~dep_u & ~ref_u).sum()),
-    }
-
-
 CONFIGS = {
     1: dict(n=100_000, min_support=10, seed=101,
             synth=dict(n_predicates=18, n_entities=17_000),
@@ -73,7 +58,7 @@ def run_one(config_id: int, strategy: int) -> dict:
         "total_pairs": total_pairs,
         "pairs_per_sec_per_chip": round(total_pairs / wall, 1) if wall else 0,
         "cinds": len(table),
-        "cind_families": _cind_family_counts(table),
+        "cind_families": table.family_counts(),
         "n_triples": spec["n"],
         "min_support": spec["min_support"],
     }

@@ -77,6 +77,20 @@ class CindTable:
             )
         }
 
+    def family_counts(self) -> dict:
+        """CIND counts per arity family {"11", "12", "21", "22"} — the
+        reference's per-family debug report (TraversalStrategy.scala:101-107)."""
+        dep = np.asarray(self.dep_code)
+        ref = np.asarray(self.ref_code)
+        dep_u = cc.is_unary(dep)
+        ref_u = cc.is_unary(ref)
+        return {
+            "11": int((dep_u & ref_u).sum()),
+            "12": int((dep_u & ~ref_u).sum()),
+            "21": int((~dep_u & ref_u).sum()),
+            "22": int((~dep_u & ~ref_u).sum()),
+        }
+
     def decoded(self, dictionary) -> list[Cind]:
         """Resolve interned ids back to strings via `dictionary` (see dictionary.py)."""
 

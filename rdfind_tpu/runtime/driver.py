@@ -532,6 +532,13 @@ def run(cfg: Config) -> RunResult:
     counters["cind-counter"] = len(table)
     counters.update({f"stat-{k}": v for k, v in stats.items()})
 
+    if cfg.debug_level >= 1 and len(table) and _is_primary():
+        # Per-family CIND counts (TraversalStrategy.scala:101-107).
+        fams = table.family_counts()
+        print("CIND families: " + ", ".join(
+            f"{k[0]}/{k[1]}: {v}" for k, v in fams.items()), file=sys.stderr)
+        counters.update({f"cinds-{k}": v for k, v in fams.items()})
+
     if cfg.debug_level >= 2 and len(table):
         # DEBUG_LEVEL_SANITY: trivial CINDs in the output indicate a pipeline
         # bug (the reference's check, RDFind.scala:497-504).

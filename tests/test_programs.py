@@ -252,3 +252,12 @@ def test_package_discover_api():
         assert len(t) > 0
     with pytest.raises(ValueError, match="unknown traversal strategy"):
         rdfind_tpu.discover(ids, 2, strategy=9)
+
+
+def test_rdfind_family_counts_debug(fixture_file, capsys):
+    rc = rdfind.main([fixture_file, "--support", "2", "--debug-level", "1",
+                      "--counters", "1"])
+    assert rc == 0
+    _, err = capsys.readouterr()
+    assert "CIND families: 1/1:" in err
+    assert "cinds-11:" in err
