@@ -40,3 +40,42 @@ def generate_triples(n: int, seed: int = 0, n_predicates: int = 24,
     pred_ids = n_entities + pred
     obj_ids = np.where(is_uri, obj_uri, n_entities + n_predicates + obj_lit)
     return np.stack([subj_ids, pred_ids, obj_ids.astype(np.int32)], axis=1)
+
+
+def inject_cind_structure(triples: np.ndarray, n_rules: int = 32,
+                          ref_size: int = 150,
+                          dep_size: int = 120) -> np.ndarray:
+    """Append a structured overlay that plants genuine high-support CINDs.
+
+    IID-ish synthetic data cannot sustain *exact* containment at high support
+    (more triples per capture means more distinct values, so perfect inclusion
+    gets rarer as n grows) — real RDF has structural inclusions instead
+    (every <x a :Professor> also <x a :Person>).  This overlay reproduces
+    that: for each of ``n_rules`` fresh predicate pairs (a, b), ``ref_size``
+    fresh subjects get (s, b, o_s) and the first ``dep_size`` of them also get
+    (s, a, o'_s), making s[p=a] < s[p=b] hold exactly with support
+    ``dep_size``.  Fresh id ranges keep the overlay from perturbing the base
+    distribution.
+    """
+    base = int(triples.max()) + 1 if triples.size else 0
+    rows = []
+    for k in range(n_rules):
+        subj = base + np.arange(ref_size, dtype=np.int64)
+        pred_a = base + ref_size + 2 * k
+        pred_b = pred_a + 1
+        obj_b = base + ref_size + 2 * n_rules + np.arange(ref_size)
+        obj_a = obj_b + ref_size  # distinct object pool per side
+        if k % 2 == 0:
+            rows.append(np.stack([subj, np.full(ref_size, pred_b), obj_b], 1))
+        else:
+            # Shared object on the referenced side: the tightest referenced
+            # capture is the *binary* s[p=b, o=hub], planting 1/2-family
+            # CINDs as well.
+            hub = obj_b[0]
+            rows.append(np.stack([subj, np.full(ref_size, pred_b),
+                                  np.full(ref_size, hub)], 1))
+        rows.append(np.stack([subj[:dep_size], np.full(dep_size, pred_a),
+                              obj_a[:dep_size]], 1))
+        base = int(max(obj_a.max(), pred_b)) + 1
+    overlay = np.concatenate(rows).astype(np.int32)
+    return np.concatenate([np.asarray(triples, np.int32), overlay])

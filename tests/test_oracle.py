@@ -112,3 +112,19 @@ def test_implies_equal_code_quirk():
     for found in (oracle.discover_cinds_definitional(triples, 1),
                   oracle.discover_cinds_joinline(triples, 1)):
         assert not any(c[:6] == (*dep, *ref) for c in found)
+
+
+def test_inject_cind_structure_plants_high_support_cinds():
+    """The structural overlay guarantees planted 1/1 + 1/2 CINDs at the
+    requested support on top of any base workload."""
+    from rdfind_tpu.models import allatonce
+    from rdfind_tpu.utils.synth import generate_triples, inject_cind_structure
+
+    base = generate_triples(2_000, seed=9, n_predicates=8, n_entities=64)
+    t = inject_cind_structure(base, n_rules=4, ref_size=30, dep_size=20)
+    table = allatonce.discover(t, 20)
+    fams = table.family_counts()
+    assert fams["11"] >= 4  # every planted rule survives at support 20
+    assert fams["12"] >= 2  # the shared-hub half plants binary-referenced ones
+    # Planted ids never collide with the base workload's.
+    assert t[: len(base)].max() < t[len(base):].min()
