@@ -13,6 +13,11 @@ measurement machinery, AbstractFlinkProgram.java:65-77,175-182): one row per
 
 Usage: python bench_matrix.py [--configs 1,2] [--strategies 0,1,2]
 Prints one JSON line per row, then a summary table on stderr.
+
+CIND-count note: strategies 0/2 emit every CIND; the small-to-large lattice
+(1) emits its raw form, whose 2/1 and 2/2 families omit 1/x-implied members
+by construction (the reference's default behavior) — so its total is lower
+while the 1/1 and 1/2 families match exactly.
 """
 
 import argparse
