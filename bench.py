@@ -47,6 +47,9 @@ def _probe_tpu_subprocess(timeout_s: int) -> tuple[bool, str]:
     return False, tail[-1] if tail else f"probe rc={r.returncode}"
 
 
+FALLBACK_REASON = None  # set when _init_backend had to abandon the TPU
+
+
 def _init_backend(retries: int = 2, delay_s: float = 5.0,
                   attempt_timeout_s: int = 120) -> str:
     """Initialize a usable jax backend, preferring the TPU; return its name.
@@ -74,8 +77,10 @@ def _init_backend(retries: int = 2, delay_s: float = 5.0,
         last_err = info
         time.sleep(delay_s * (attempt + 1))
     # Persistent TPU failure: pin to CPU before any in-process jax op.
-    sys.stderr.write(f"bench: TPU backend unavailable after {retries} probes "
-                     f"({last_err}); falling back to cpu\n")
+    global FALLBACK_REASON
+    FALLBACK_REASON = (f"TPU backend unavailable after {retries} probes "
+                       f"({last_err})")
+    sys.stderr.write(f"bench: {FALLBACK_REASON}; falling back to cpu\n")
     jax.config.update("jax_platforms", "cpu")
     return jax.devices()[0].platform
 
@@ -108,10 +113,9 @@ def _run(n: int, min_support: int) -> dict:
 
     detail = {
         "backend": backend,
-        **({} if backend != "cpu" else {
-            "backend_note": "TPU tunnel unavailable after probes; CPU "
-                            "fallback — see BASELINE.md for the measured "
-                            "real-chip headline (37.6M pairs/s, 30x oracle)"}),
+        **({} if FALLBACK_REASON is None else {
+            "backend_note": FALLBACK_REASON + "; CPU fallback — see "
+                            "BASELINE.md for the measured real-chip headline"}),
         "n_triples": n, "min_support": min_support,
         "wall_s": round(elapsed, 3), "total_pairs": stats["total_pairs"],
         "n_lines": stats["n_lines"], "max_line": stats["max_line"],

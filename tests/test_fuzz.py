@@ -1,0 +1,64 @@
+"""Differential fuzzing: random workloads through every strategy vs the oracle.
+
+All strategies must agree with the definitional oracle (strategies 1/3 in
+their raw form drop 1/x-implied 2/x members — compare via the minimized set).
+Workload sizes are pinned so every seed shares one compiled program per
+strategy (pow2 capacities equal), keeping the sweep cheap.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from rdfind_tpu import oracle
+from rdfind_tpu.dictionary import intern_triples
+from rdfind_tpu.models import allatonce, approximate, late_bb, small_to_large
+
+N_TRIPLES = 120
+
+
+def _workload(seed):
+    rng = random.Random(seed)
+    shape = rng.choice([(8, 3, 6), (20, 6, 10), (5, 2, 40)])
+    n_s, n_p, n_o = shape
+    rows = [(f"s{rng.randrange(n_s)}", f"p{rng.randrange(n_p)}",
+             f"o{rng.randrange(n_o)}") for _ in range(N_TRIPLES)]
+    ids, _ = intern_triples(np.asarray(rows, dtype=object))
+    return rows, ids
+
+
+def _check_seed(seed, min_support):
+    rows, ids = _workload(seed)
+    t = [tuple(int(x) for x in r) for r in ids]
+    want_full = {tuple(c) for c in
+                 oracle.discover_cinds_definitional(t, min_support)}
+    want_min = {tuple(c) for c in oracle.minimize_cinds(want_full)}
+
+    for name, fn, exact in (("allatonce", allatonce.discover, True),
+                            ("approximate", approximate.discover, True),
+                            ("s2l", small_to_large.discover, False),
+                            ("late_bb", late_bb.discover, False)):
+        got = fn(ids, min_support)
+        if exact:
+            assert got.to_rows() == want_full, f"{name} seed={seed}"
+        else:
+            got_min = {tuple(c) for c in oracle.minimize_cinds(got.to_rows())}
+            assert got_min == want_min, f"{name} seed={seed}"
+    # Flag variants of the default strategy stay output-identical.
+    base = small_to_large.discover(ids, min_support).to_rows()
+    for kw in (dict(balanced_11=True),
+               dict(explicit_threshold=4, sbf_bits=8)):
+        got = small_to_large.discover(ids, min_support, **kw).to_rows()
+        assert got == base, f"s2l variant {kw} seed={seed}"
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fuzz_strategies(seed):
+    _check_seed(seed, min_support=2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(3, 15))
+def test_fuzz_strategies_extended(seed):
+    _check_seed(seed, min_support=1 + seed % 3)
