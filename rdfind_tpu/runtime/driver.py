@@ -15,7 +15,6 @@ import time
 
 import numpy as np
 
-from .. import oracle
 from ..data import CindTable
 from ..dictionary import Dictionary, intern_triples
 from ..io import native, ntriples, prefixes, reader
