@@ -607,10 +607,15 @@ T_LOC_FLOOR = 256
 CAP_FLOOR = 512
 
 
-def _shard_triples(triples, num_dev):
-    """Contiguous per-device split, padded to a shared power-of-two block."""
+def _shard_triples(triples, num_dev, t_loc: int | None = None):
+    """Contiguous per-device split, padded to a shared power-of-two block.
+
+    `t_loc` overrides the block size (the multi-host ingest agrees on one
+    globally so every host's blocks tile the same global array).
+    """
     n = triples.shape[0]
-    t_loc = max(T_LOC_FLOOR, segments.pow2_capacity(-(-n // num_dev)))
+    if t_loc is None:
+        t_loc = max(T_LOC_FLOOR, segments.pow2_capacity(-(-n // num_dev)))
     padded = np.full((num_dev * t_loc, 3), np.iinfo(np.int32).max, np.int32)
     n_valid = np.zeros(num_dev, np.int32)
     for dev in range(num_dev):
@@ -639,7 +644,8 @@ class _Pipeline:
     """
 
     def __init__(self, mesh, triples, min_support, projections, use_fis,
-                 use_ars, max_retries, stats, skew=None, combine=True):
+                 use_ars, max_retries, stats, skew=None, combine=True,
+                 preshard=None):
         self.mesh = mesh
         self.num_dev = mesh.devices.size
         self.min_support = min_support
@@ -647,9 +653,14 @@ class _Pipeline:
         self.stats = stats
         self.skew = skew if skew is not None else DEFAULT_SKEW
         self.combine = combine
-        padded, n_valid, _ = _shard_triples(triples, self.num_dev)
-        self._triples = make_global(padded, mesh)
-        self._n_valid = make_global(n_valid, mesh)
+        if preshard is not None:
+            # Pre-built global arrays (sharded multi-host ingest:
+            # runtime/multihost_ingest.py) — no host ever held the full table.
+            self._triples, self._n_valid = preshard
+        else:
+            padded, n_valid, _ = _shard_triples(triples, self.num_dev)
+            self._triples = make_global(padded, mesh)
+            self._n_valid = make_global(n_valid, mesh)
 
         # P1: measured plan for the pre-exchange capacities.
         cap_f, cap_a = _plan_step(self._triples, self._n_valid, mesh=mesh,
@@ -886,24 +897,36 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
                      clean_implied: bool = False,
                      max_retries: int = 4, stats: dict | None = None,
                      skew: SkewPolicy | None = None,
-                     combine: bool = True) -> CindTable:
+                     combine: bool = True,
+                     preshard=None) -> CindTable:
     """Discover all CINDs with the full AllAtOnce step sharded over `mesh`.
 
     Output is identical to models.allatonce.discover with matching flags.  If
     `stats` is a dict it receives skew-engine counters (n_giant_lines,
     n_giant_pairs) and the measured capacity plan (planned_caps).
+
+    `preshard=(global_triples, global_n_valid)` feeds pre-built global arrays
+    (sharded multi-host ingest — runtime/multihost_ingest.py) instead of a
+    host triple table; `triples` is then ignored and may be None.  AR mining
+    needs the host table, so use_ars is unsupported with preshard.
     """
     if mesh is None:
         mesh = make_mesh()
-    triples = np.asarray(triples, np.int32)
-    n = triples.shape[0]
-    if n == 0 or not any(ch in projections for ch in "spo"):
+    if preshard is None:
+        triples = np.asarray(triples, np.int32)
+        if triples.shape[0] == 0:
+            return CindTable.empty()
+    elif use_ars:
+        raise ValueError("use_ars requires a host triple table; "
+                         "unsupported with preshard")
+    if not any(ch in projections for ch in "spo"):
         return CindTable.empty()
     min_support = max(int(min_support), 1)
     use_ars = use_ars and use_fis
 
     pipe = _Pipeline(mesh, triples, min_support, projections, use_fis, use_ars,
-                     max_retries, stats, skew=skew, combine=combine)
+                     max_retries, stats, skew=skew, combine=combine,
+                     preshard=preshard)
     d_code, d_v1, d_v2, r_code, r_v1, r_v2, support = pipe.run_cinds()
 
     table = CindTable(

@@ -120,6 +120,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--file-filter", default=None,
                    help="regex on input-file basenames (the reference's "
                         "file-filtered directory scan)")
+    p.add_argument("--sharded-ingest", action="store_true",
+                   help="each host parses only its file subset and donates "
+                        "rows to its own devices (multi-host; no host holds "
+                        "the full triple table; strategy 0 only)")
     p.add_argument("--no-native-ingest", action="store_true",
                    help="force the pure-Python ingest path")
     p.add_argument("--checkpoint-dir", default=None,
@@ -204,6 +208,7 @@ def main(argv=None) -> int:
         collector=args.collector,
         find_only_fcs=args.find_only_fcs,
         create_join_histogram=args.create_join_histogram,
+        sharded_ingest=args.sharded_ingest,
     )
     # Un-silence the remaining compatibility no-ops (the reference's
     # JVM-dataflow levers that the TPU design subsumes).

@@ -66,6 +66,7 @@ class Config:
     collector: str | None = None  # "host:port" remote result sink (RMI analog)
     find_only_fcs: int = 0  # >=1: stop after frequent-condition mining
     create_join_histogram: bool = False  # print join-line size histogram
+    sharded_ingest: bool = False  # each host parses only its file subset
 
 
 @dataclasses.dataclass
@@ -309,6 +310,58 @@ def _trivial_cind_mask(table: CindTable) -> np.ndarray:
     return same_proj & sub & v_ok
 
 
+def _run_sharded_ingest(cfg: Config, phases: _Phases,
+                        counters: dict) -> RunResult:
+    """Multi-host sharded ingest + preshard discovery (each host parses only
+    its file subset; no host materializes the full triple table)."""
+    unsupported = [
+        (cfg.traversal_strategy != 0, "--traversal-strategy != 0"),
+        (cfg.checkpoint_dir is not None, "--checkpoint-dir"),
+        (cfg.asciify_triples, "--asciify-triples"),
+        (bool(cfg.prefix_paths), "--prefixes"),
+        (cfg.distinct_triples, "--distinct-triples"),
+        (cfg.only_read or cfg.only_join, "--only-read/--do-only-join"),
+        (cfg.use_association_rules, "--use-ars"),
+        (cfg.ar_output_file is not None, "--ar-output"),
+        (cfg.find_only_fcs > 0, "--find-only-fcs"),
+        (cfg.create_join_histogram, "--create-join-histogram"),
+    ]
+    bad = [name for cond, name in unsupported if cond]
+    if bad:
+        raise ValueError(
+            f"--sharded-ingest does not support {', '.join(bad)} (these need "
+            f"the full host triple table; use the replicated ingest)")
+
+    from . import multihost_ingest
+
+    paths, is_nq = _resolve_inputs(cfg)
+    mesh = make_mesh(cfg.n_devices if cfg.n_devices > 1 else None)
+
+    def ingest():
+        return multihost_ingest.sharded_ingest(
+            paths, mesh, tabs=cfg.tabs, expect_quad=is_nq,
+            encoding=cfg.encoding, use_native=cfg.native_ingest)
+
+    g_triples, g_valid, dictionary, total = phases.run("sharded-ingest",
+                                                       ingest)
+    counters["input-triples"] = total
+    counters["distinct-values"] = len(dictionary)
+
+    stats: dict = {}
+    skew = _skew_from_cfg(cfg)
+    table = phases.run("discover", lambda: sharded.discover_sharded(
+        None, cfg.min_support, mesh=mesh, skew=skew,
+        combine=cfg.combinable_join, projections=cfg.projections,
+        use_fis=cfg.use_frequent_item_set,
+        clean_implied=cfg.clean_implied, stats=stats,
+        preshard=(g_triples, g_valid)))
+    counters["cind-counter"] = len(table)
+    counters.update({f"stat-{k}": v for k, v in stats.items()})
+    _emit_sinks(cfg, phases, counters, table, dictionary, stats, None)
+    _report(cfg, counters, phases.timings)
+    return RunResult(table, dictionary, None, counters, phases.timings)
+
+
 def run(cfg: Config) -> RunResult:
     phases = _Phases()
     counters: dict = {}
@@ -316,6 +369,9 @@ def run(cfg: Config) -> RunResult:
     if cfg.print_plan and _is_primary():
         import json as _json
         print(_json.dumps(describe_plan(cfg), indent=2))
+
+    if cfg.sharded_ingest:
+        return _run_sharded_ingest(cfg, phases, counters)
 
     # Native fused ingest (read+parse+intern in one C++ pass) whenever the
     # string-level preprocessing options that need raw tokens are off.
@@ -530,7 +586,16 @@ def run(cfg: Config) -> RunResult:
             phases.run("checkpoint-discover", save_discover)
     counters["cind-counter"] = len(table)
     counters.update({f"stat-{k}": v for k, v in stats.items()})
+    _emit_sinks(cfg, phases, counters, table, dictionary, stats, ids)
 
+    _report(cfg, counters, phases.timings)
+    return RunResult(table, dictionary, ids, counters, phases.timings)
+
+
+def _emit_sinks(cfg: Config, phases: _Phases, counters: dict, table,
+                dictionary, stats: dict, ids) -> None:
+    """Debug reports + every result sink; shared by the replicated and the
+    sharded-ingest paths so they can never diverge."""
     if cfg.debug_level >= 1 and len(table) and _is_primary():
         # Per-family CIND counts (TraversalStrategy.scala:101-107).
         fams = table.family_counts()
@@ -558,6 +623,8 @@ def run(cfg: Config) -> RunResult:
             if mined is None:
                 from ..ops import frequency as freq_ops
                 mined = freq_ops.mine_association_rules(ids, cfg.min_support)
+                # (ids is always present here: the sharded-ingest path
+                # rejects --use-ars up front.)
             ants, cons, avs, cvs, sups = mined
             counters["association-rules"] = len(ants)
             from .. import conditions as cc
@@ -610,9 +677,6 @@ def run(cfg: Config) -> RunResult:
     if (cfg.collect_result or cfg.debug_level >= 3) and _is_primary():
         for c in table.decoded(dictionary):
             print(c.pretty())
-
-    _report(cfg, counters, phases.timings)
-    return RunResult(table, dictionary, ids, counters, phases.timings)
 
 
 def _report(cfg: Config, counters: dict, timings: dict) -> None:

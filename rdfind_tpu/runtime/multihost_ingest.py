@@ -1,0 +1,151 @@
+"""Sharded multi-host ingest: each host reads a disjoint file subset.
+
+The 400M-triple-scale blocker (SURVEY.md §7 hard parts: "string<->ID lifecycle
+at 400M-triple scale: distributed dictionary build") solved the TPU-native
+way: hosts parse + intern their own file shards in parallel (native C++ pass
+where applicable), agree on ONE global dictionary by exchanging their distinct
+value sets (the analog of the reference's cluster-wide hash dictionary build,
+plan/FrequentConditionPlanner.scala:59-91 — except exact, sorted-unique, and
+collision-free), remap local ids, and donate their triple rows directly to
+their own devices as one jax global array — no host ever materializes the
+full triple table.
+
+Value-set exchange budget: the union of distinct values is replicated on
+every host (numpy strings), i.e. O(global dictionary) host RAM — the same
+budget class as the capture table (models/sharded.capture_table).  Beyond
+that scale the next step is hash-partitioned interning (each host owns a
+value-hash range); the triple table itself already never leaves its host.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..dictionary import Dictionary
+from ..io import native, ntriples, reader
+
+
+def shard_paths(paths: list[str], num_hosts: int, host_index: int) -> list[str]:
+    """Round-robin file ownership (file sizes are typically uniform shards)."""
+    return paths[host_index::num_hosts]
+
+
+def _local_ingest(paths, tabs: bool, expect_quad: bool, encoding,
+                  use_native: bool = True):
+    """This host's file subset -> (local (N,3) int32 ids, local Dictionary)."""
+    if not paths:
+        return np.zeros((0, 3), np.int32), Dictionary(np.zeros(0, object))
+    if use_native and native.available() and encoding == "utf-8":
+        return native.ingest_files(paths, tabs=tabs, expect_quad=expect_quad)
+    from ..dictionary import intern_triples
+
+    rows = []
+    for _, line in reader.iter_lines(paths, encoding=encoding):
+        t = (ntriples.parse_tab_line(line) if tabs
+             else ntriples.parse_line(line, expect_quad=expect_quad))
+        if t is not None:
+            rows.append(t)
+    if not rows:
+        return np.zeros((0, 3), np.int32), Dictionary(np.zeros(0, object))
+    return intern_triples(np.asarray(rows, dtype=object))
+
+
+def _allgather_values(local_values: np.ndarray) -> np.ndarray:
+    """Union of every host's distinct values, identical on every host.
+
+    Strings travel as one UTF-8 blob + offsets, padded to the global max so
+    process_allgather sees fixed shapes.
+    """
+    import jax
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return np.asarray(local_values, object)
+    encoded = [str(v).encode("utf-8") for v in local_values]
+    blob = b"".join(encoded)
+    offsets = np.zeros(len(encoded) + 1, np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+
+    sizes = np.asarray([len(blob), len(offsets)], np.int64)
+    all_sizes = np.asarray(multihost_utils.process_allgather(sizes))
+    max_blob, max_offs = int(all_sizes[:, 0].max()), int(all_sizes[:, 1].max())
+
+    blob_pad = np.zeros(max_blob, np.uint8)
+    blob_pad[: len(blob)] = np.frombuffer(blob, np.uint8)
+    offs_pad = np.full(max_offs, -1, np.int64)
+    offs_pad[: len(offsets)] = offsets
+    all_blobs = np.asarray(multihost_utils.process_allgather(blob_pad))
+    all_offs = np.asarray(multihost_utils.process_allgather(offs_pad))
+
+    values = []
+    for h in range(all_sizes.shape[0]):
+        offs = all_offs[h]
+        offs = offs[offs >= 0]
+        raw = all_blobs[h].tobytes()
+        values.extend(raw[offs[i]:offs[i + 1]].decode("utf-8")
+                      for i in range(len(offs) - 1))
+    return np.unique(np.asarray(values, object))
+
+
+def sharded_ingest(paths: list[str], mesh, *, tabs: bool = False,
+                   expect_quad: bool = False, encoding="utf-8",
+                   use_native: bool = True):
+    """Multi-host ingest over `mesh`.
+
+    Returns (global_triples, global_n_valid, dictionary, total_triples):
+    `global_triples` is a (D * t_loc, 3) int32 jax Array row-sharded over the
+    mesh where each host donated only its own rows; `dictionary` is the
+    identical global Dictionary on every host.
+    """
+    import jax
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..models.sharded import T_LOC_FLOOR
+    from ..ops import segments
+    from ..parallel.mesh import AXIS
+
+    num_hosts = jax.process_count()
+    host_index = jax.process_index()
+    my_paths = shard_paths(paths, num_hosts, host_index)
+    local_ids, local_dict = _local_ingest(my_paths, tabs, expect_quad,
+                                          encoding, use_native)
+
+    # One global dictionary, computed identically on every host.
+    global_values = _allgather_values(local_dict.values)
+    dictionary = Dictionary(global_values)
+    if len(local_dict):
+        remap = np.searchsorted(global_values, local_dict.values).astype(
+            np.int32)
+        local_ids = remap[local_ids]
+
+    # Per-device layout: the mesh's devices are process-contiguous, so this
+    # host's devices own one contiguous row block.  t_loc is agreed globally
+    # from the max per-host row count (any distribution is correct — exchange
+    # A re-routes every row by hash anyway).
+    num_dev = mesh.devices.size
+    dev_local = max(1, num_dev // max(num_hosts, 1))
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([local_ids.shape[0]], np.int64))).reshape(-1) \
+        if num_hosts > 1 else np.asarray([local_ids.shape[0]])
+    total = int(counts.sum())
+    t_loc = max(T_LOC_FLOOR,
+                segments.pow2_capacity(-(-int(counts.max()) // dev_local)))
+
+    from ..models.sharded import _shard_triples
+
+    local_block, n_valid_local, _ = _shard_triples(local_ids, dev_local,
+                                                   t_loc=t_loc)
+
+    t_shard = NamedSharding(mesh, P(AXIS, None))
+    v_shard = NamedSharding(mesh, P(AXIS))
+    if num_hosts == 1:
+        g_triples = jax.device_put(local_block, t_shard)
+        g_valid = jax.device_put(n_valid_local, v_shard)
+    else:
+        g_triples = jax.make_array_from_process_local_data(
+            t_shard, local_block, (num_dev * t_loc, 3))
+        g_valid = jax.make_array_from_process_local_data(
+            v_shard, n_valid_local, (num_dev,))
+    return g_triples, g_valid, dictionary, total

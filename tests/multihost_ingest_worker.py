@@ -1,0 +1,42 @@
+"""Worker: sharded multi-host ingest + preshard discovery in a 2-process run.
+
+Each process parses only its own file subset; the hosts exchange distinct
+values for the global dictionary, donate rows to their own devices, and run
+the sharded AllAtOnce over the assembled global array.  Process 0 prints the
+decoded CINDs for the parent to compare against a single-process golden run.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    paths = sys.argv[4].split(",")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from rdfind_tpu.models import sharded
+    from rdfind_tpu.parallel import mesh as mesh_mod
+    from rdfind_tpu.runtime import multihost_ingest
+
+    mesh_mod.initialize_multihost(f"127.0.0.1:{port}", nproc, pid)
+    mesh = mesh_mod.make_mesh()
+    g_triples, g_valid, dictionary, total = multihost_ingest.sharded_ingest(
+        paths, mesh)
+    table = sharded.discover_sharded(None, 1, mesh=mesh,
+                                     preshard=(g_triples, g_valid))
+    if pid == 0:
+        out = sorted(c.pretty() for c in table.decoded(dictionary))
+        print("TOTAL " + str(total), flush=True)
+        print("CINDS " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -63,3 +63,47 @@ def test_two_process_discovery_s2l():
     got = [tuple(r) for r in _run_workers("1")]
     want = [tuple(r) for r in _golden("1")]
     assert got == want
+
+
+NT_SHARDS = [
+    "<alice> <knows> <bob> .\n<bob> <knows> <carol> .\n",
+    "<carol> <knows> <alice> .\n<alice> <likes> <bob> .\n",
+    "<bob> <likes> <carol> .\n<carol> <likes> <alice> .\n",
+    "<dave> <knows> <alice> .\n<dave> <likes> <alice> .\n",
+]
+
+
+def test_two_process_sharded_ingest(tmp_path):
+    """Each host parses only its file subset; the global dictionary and the
+    discovery output must equal a single-process run over all files."""
+    paths = []
+    for i, content in enumerate(NT_SHARDS):
+        p = tmp_path / f"shard{i}.nt"
+        p.write_text(content)
+        paths.append(str(p))
+
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [subprocess.Popen(
+        [sys.executable,
+         os.path.join(_REPO, "tests", "multihost_ingest_worker.py"),
+         str(pid), "2", str(port), ",".join(paths)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for pid in range(2)]
+    outs = [p.communicate(timeout=540) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+    lines = dict(l.split(" ", 1) for l in outs[0][0].splitlines()
+                 if l.startswith(("TOTAL", "CINDS")))
+
+    # Golden: single-process ingest of all files + single-device discovery
+    # (same ingest selection as the workers: native when available).
+    from rdfind_tpu.io import native
+    from rdfind_tpu.models import allatonce
+    from rdfind_tpu.runtime import multihost_ingest
+    ids, d = multihost_ingest._local_ingest(paths, False, False, "utf-8")
+    assert int(lines["TOTAL"]) == ids.shape[0]
+    want = sorted(c.pretty()
+                  for c in allatonce.discover(ids, 1).decoded(d))
+    assert json.loads(lines["CINDS"]) == want

@@ -261,3 +261,31 @@ def test_rdfind_family_counts_debug(fixture_file, capsys):
     _, err = capsys.readouterr()
     assert "CIND families: 1/1:" in err
     assert "cinds-11:" in err
+
+
+def test_rdfind_sharded_ingest_single_process(tmp_path, capsys):
+    """--sharded-ingest works single-process too (one host owns all files)
+    and matches the replicated-ingest output."""
+    files = []
+    for i, content in enumerate([
+            "<a> <p> <x> .\n<b> <p> <x> .\n",
+            "<a> <q> <x> .\n<b> <q> <x> .\n<c> <q> <y> .\n"]):
+        f = tmp_path / f"s{i}.nt"
+        f.write_text(content)
+        files.append(str(f))
+    rc = rdfind.main([*files, "--support", "1", "--traversal-strategy", "0",
+                      "--output", str(tmp_path / "a.txt")])
+    assert rc == 0
+    rc = rdfind.main([*files, "--support", "1", "--traversal-strategy", "0",
+                      "--sharded-ingest", "--dop", "2",
+                      "--output", str(tmp_path / "b.txt")])
+    assert rc == 0
+    assert (tmp_path / "a.txt").read_text() == (tmp_path / "b.txt").read_text()
+
+
+def test_rdfind_sharded_ingest_rejects_incompatible(tmp_path):
+    f = tmp_path / "x.nt"
+    f.write_text("<a> <p> <x> .\n")
+    with pytest.raises(ValueError, match="sharded-ingest does not support"):
+        rdfind.main([str(f), "--sharded-ingest", "--use-fis", "--use-ars",
+                     "--support", "1", "--traversal-strategy", "0"])
