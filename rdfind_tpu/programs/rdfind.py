@@ -76,7 +76,8 @@ def build_parser() -> argparse.ArgumentParser:
     # printed when set so no flag is a *silent* no-op):
     for flag in ("--find-frequent-captures", "--no-bulk-merge",
                  "--rebalance-join", "--apply-hash",
-                 "--hash-dictionary", "--only-read-compat"):
+                 "--hash-dictionary", "--only-read-compat",
+                 "--any-binary-captures"):
         p.add_argument(flag, action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--no-combinable-join", action="store_true",
                    help="plan ablation: ship raw join candidates instead of "
@@ -228,7 +229,10 @@ def main(argv=None) -> int:
             ("rebalance_join",
              "the skew engine is always on for sharded runs; tune it with "
              "--rebalance-threshold/--rebalance-max-load"),
-            ("only_read_compat", "use --only-read")):
+            ("only_read_compat", "use --only-read"),
+            ("any_binary_captures",
+             "binary condition frequencies are computed exactly in the same "
+             "pass as unary ones; there is no pre-pass to skip")):
         v = getattr(args, name, None)
         default = {"rebalance_split": 1, "frequent_condition_strategy": 0,
                    "hash_bytes": -1, "hash_function": "MD5"}.get(name, False)
