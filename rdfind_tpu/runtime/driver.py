@@ -244,14 +244,18 @@ _STRATEGY_PLANS = {
 
 def describe_plan(cfg: Config) -> dict:
     """A JSON-able description of the stages this config will execute."""
-    pre = ["read+parse"]
-    if cfg.asciify_triples:
-        pre.append("asciify")
-    if cfg.prefix_paths:
-        pre.append("shorten-urls")
-    pre.append("intern")
-    if cfg.distinct_triples:
-        pre.append("distinct")
+    if cfg.sharded_ingest:
+        pre = ["sharded-ingest (per-host parse+intern, global dictionary "
+               "exchange, per-device row donation)"]
+    else:
+        pre = ["read+parse"]
+        if cfg.asciify_triples:
+            pre.append("asciify")
+        if cfg.prefix_paths:
+            pre.append("shorten-urls")
+        pre.append("intern")
+        if cfg.distinct_triples:
+            pre.append("distinct")
     discover = list(_STRATEGY_PLANS.get(cfg.traversal_strategy, ["unknown"]))
     if cfg.use_frequent_item_set:
         discover.insert(0, "frequent-item-sets (condition-support filter)")
