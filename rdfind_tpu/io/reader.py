@@ -94,6 +94,21 @@ def encoding_for(path: str, encoding) -> str:
     return enc
 
 
+def is_utf8(encoding) -> bool:
+    """True when ``encoding`` names UTF-8 under any alias (UTF-8, utf8, U8...).
+
+    Gate for the UTF-8-only native ingest.  ``"auto"`` (BOM sniff) and
+    dict/callable per-file specs are not statically UTF-8, so they return
+    False; unknown codec names also return False rather than raising.
+    """
+    if not isinstance(encoding, str) or encoding == "auto":
+        return False
+    try:
+        return codecs.lookup(encoding).name == "utf-8"
+    except LookupError:
+        return False
+
+
 def open_text(path: str, encoding="utf-8"):
     enc = encoding_for(path, encoding)
     return io.TextIOWrapper(_open_raw(path), encoding=enc, errors="replace")

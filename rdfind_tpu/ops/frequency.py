@@ -21,6 +21,7 @@ Fixed-shape and jittable: `valid` masks padding rows, which always count as 0.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -144,16 +145,58 @@ def ar_implied_pair_mask(dep_code, ref_code, dep_v1, ref_v1, mined_rules):
     return out
 
 
+@functools.partial(jax.jit, static_argnames="field_groups")
+def _stage_count_fcs(triples, n_valid, min_support, field_groups):
+    """Distinct frequent conditions over `field_groups`, summed (device-side)."""
+    n = triples.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < n_valid
+    total = jnp.int32(0)
+    for fields in field_groups:
+        cols = [triples[:, f] for f in fields]
+        cnt = segments.masked_row_counts(cols, valid)
+        ok = valid & (cnt >= min_support)
+        _, _, _, n_u = segments.masked_unique(cols, ok)
+        total += n_u
+    return total
+
+
+def _pad_to_device(triples_np):
+    """(N, 3) int32 -> pow2-padded device array (SENTINEL-padded rows)."""
+    n = triples_np.shape[0]
+    cap = segments.pow2_capacity(n)
+    padded = np.pad(triples_np, ((0, cap - n), (0, 0)),
+                    constant_values=np.iinfo(np.int32).max)
+    return jnp.asarray(padded)
+
+
+def count_frequent_conditions(triples_np, min_support: int,
+                              include_binary: bool):
+    """Distinct frequent unary (and optionally binary) condition counts.
+
+    The --find-only-fcs report path (RDFind.scala:298-306: level >= 1 emits the
+    single-condition filters and stops; level >= 2 additionally emits the
+    double-condition filters).  Runs on the same device segment-count ops as
+    the real pipeline so the flag exercises the production frequency code.
+    Returns (n_unary, n_binary) with n_binary None when not requested.
+    """
+    n = triples_np.shape[0]
+    if n == 0:
+        return 0, (0 if include_binary else None)
+    dev = _pad_to_device(triples_np)
+    ms = jnp.int32(max(int(min_support), 1))
+    n_unary = int(_stage_count_fcs(dev, jnp.int32(n), ms, ((0,), (1,), (2,))))
+    n_binary = (int(_stage_count_fcs(dev, jnp.int32(n), ms, _FIELD_PAIRS))
+                if include_binary else None)
+    return n_unary, n_binary
+
+
 def mine_association_rules(triples_np, min_support: int):
     """Host wrapper: (N, 3) int32 -> numpy rule table (ant_bit, cons_bit, ant_val,
     cons_val, support)."""
     n = triples_np.shape[0]
     if n == 0:
         return [np.zeros(0, np.int32)] * 5
-    cap = segments.pow2_capacity(n)
-    padded = np.pad(triples_np, ((0, cap - n), (0, 0)),
-                    constant_values=np.iinfo(np.int32).max)
-    out = _stage_rules(jnp.asarray(padded), jnp.int32(n),
+    out = _stage_rules(_pad_to_device(triples_np), jnp.int32(n),
                        jnp.int32(max(int(min_support), 1)))
     *cols, n_rules = out
     n_rules = int(n_rules)

@@ -100,8 +100,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print a join-line size histogram "
                         "('Join size N encountered Mx')")
     p.add_argument("--find-only-fcs", type=int, default=0,
-                   help="1: stop after frequent-condition mining (report "
-                        "counts); 2: unary conditions only")
+                   help="1: stop after frequent-condition mining, reporting "
+                        "unary counts; 2: also mine binary (double) "
+                        "conditions and association rules")
     for flag, dv in (("--rebalance-split", 1), ("--hash-bytes", -1),
                      ("--frequent-condition-strategy", 0)):
         p.add_argument(flag, type=int, default=dv, help=argparse.SUPPRESS)

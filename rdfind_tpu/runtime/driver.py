@@ -382,7 +382,7 @@ def run(cfg: Config) -> RunResult:
     use_native = (cfg.native_ingest and native.available()
                   and not cfg.asciify_triples and not cfg.prefix_paths
                   and not cfg.only_read
-                  and cfg.encoding == "utf-8")  # native parser is UTF-8-only
+                  and reader.is_utf8(cfg.encoding))  # native parser is UTF-8-only
 
     ckpt = ingest_fp = discover_fp = None
     if cfg.checkpoint_dir and not cfg.only_read:
@@ -449,22 +449,18 @@ def run(cfg: Config) -> RunResult:
 
     if cfg.find_only_fcs >= 1:
         # Stop after the frequent-condition plan (RDFind.scala:298-306):
-        # level >= 2 mines only unary conditions, level 1 also binary (+ ARs).
+        # level >= 1 emits the single-condition filters and returns; level >= 2
+        # additionally emits the double-condition filters (+ ARs here, which
+        # ride the binary counts).  Device segment-count ops, same code as the
+        # real pipeline's frequency prefilter.
         def mine_fcs():
-            n_unary = 0
-            for f in range(3):
-                _, cnts = np.unique(ids[:, f], return_counts=True)
-                n_unary += int((cnts >= cfg.min_support).sum())
+            from ..ops import frequency as freq_ops
+            n_unary, n_binary = freq_ops.count_frequent_conditions(
+                ids, cfg.min_support, include_binary=cfg.find_only_fcs >= 2)
             counters["frequent-single-conditions"] = n_unary
-            if cfg.find_only_fcs < 2:
-                n_binary = 0
-                for a, b in ((0, 1), (0, 2), (1, 2)):
-                    _, cnts = np.unique(ids[:, [a, b]], axis=0,
-                                        return_counts=True)
-                    n_binary += int((cnts >= cfg.min_support).sum())
+            if n_binary is not None:
                 counters["frequent-double-conditions"] = n_binary
                 if cfg.use_association_rules and cfg.use_frequent_item_set:
-                    from ..ops import frequency as freq_ops
                     rules = freq_ops.mine_association_rules(
                         ids, cfg.min_support)
                     counters["association-rules"] = len(rules[0])

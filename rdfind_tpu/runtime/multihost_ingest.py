@@ -35,7 +35,7 @@ def _local_ingest(paths, tabs: bool, expect_quad: bool, encoding,
     """This host's file subset -> (local (N,3) int32 ids, local Dictionary)."""
     if not paths:
         return np.zeros((0, 3), np.int32), Dictionary(np.zeros(0, object))
-    if use_native and native.available() and encoding == "utf-8":
+    if use_native and native.available() and reader.is_utf8(encoding):
         return native.ingest_files(paths, tabs=tabs, expect_quad=expect_quad)
     from ..dictionary import intern_triples
 

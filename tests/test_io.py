@@ -150,3 +150,16 @@ def test_reader_callable_auto_encoding(tmp_path):
     assert reader.encoding_for(str(f), lambda p: "auto") == "utf-16"
     (_, line), = reader.iter_lines([str(f)], encoding=lambda p: "auto")
     assert "é" in line
+
+
+def test_is_utf8_aliases():
+    """UTF-8 aliases enable the native path; auto/unknown/non-utf8 do not."""
+    assert reader.is_utf8("utf-8")
+    assert reader.is_utf8("UTF-8")
+    assert reader.is_utf8("utf8")
+    assert reader.is_utf8("U8")
+    assert not reader.is_utf8("auto")
+    assert not reader.is_utf8("latin-1")
+    assert not reader.is_utf8("no-such-codec")
+    assert not reader.is_utf8({"a.nt": "utf-8"})
+    assert not reader.is_utf8(None)

@@ -1,0 +1,139 @@
+"""TPU-recovery watcher: probe the axon tunnel until it answers, then bench.
+
+This is the recovery artifact BASELINE.md promises (round-3 advice finding:
+the doc claimed "a background watcher retries the tunnel" but no watcher was
+committed).  The axon tunnel admits one client at a time and can wedge
+indefinitely after a holder is killed; probing in a killable subprocess is
+the only reliable verdict (see bench.py:_probe_tpu_subprocess).
+
+Loop: probe -> on success run `bench.py` (headline) and `bench_matrix.py`
+(configs 1-2 x strategies 0/1/2), append rows to BENCH_TPU_MATRIX.jsonl,
+write the headline line to BENCH_TPU_HEADLINE.json, then exit.  On failure
+sleep and retry until --deadline-h expires or a `tpu_watch.stop` file
+appears next to this script.
+
+Run detached:  nohup python tpu_watch.py >> tpu_watch.log 2>&1 &
+
+Mirrors the reference's always-reporting measurement discipline
+(AbstractFlinkProgram.java:65-77,175-182): every probe attempt and every
+outcome is logged; the watcher never exits silently.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+STOP_FILE = os.path.join(REPO, "tpu_watch.stop")
+
+
+def log(msg: str) -> None:
+    print(f"[tpu_watch {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe(timeout_s: int = 120) -> bool:
+    """Probe the default (axon/TPU) backend in a killable subprocess."""
+    code = ("import jax, jax.numpy as jnp;"
+            "d = jax.devices();"
+            "jax.block_until_ready(jnp.zeros((8,), jnp.int32) + 1);"
+            "print(d[0].platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout_s, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log(f"probe timed out after {timeout_s}s")
+        return False
+    if r.returncode == 0 and r.stdout.strip().splitlines()[-1:] != ["cpu"]:
+        log(f"probe ok: platform={r.stdout.strip().splitlines()[-1]}")
+        return True
+    tail = (r.stderr or "").strip().splitlines()[-1:] or [f"rc={r.returncode}"]
+    log(f"probe failed: {tail[0]}")
+    return False
+
+
+def run_benches() -> bool:
+    """Run the headline bench + the config matrix on the (live) TPU.
+
+    Generous timeouts: killing a TPU-holding process mid-run is what wedges
+    the tunnel in the first place, so these only fire as a last resort.
+    """
+    ok = True
+    env = dict(os.environ)
+    log("running bench.py (headline)...")
+    try:
+        r = subprocess.run([sys.executable, "bench.py"], capture_output=True,
+                           text=True, timeout=2400, cwd=REPO, env=env)
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+        log(f"bench.py rc={r.returncode}: {line[:200]}")
+        if line:
+            with open(os.path.join(REPO, "BENCH_TPU_HEADLINE.json"), "w") as f:
+                f.write(line + "\n")
+        ok &= r.returncode == 0 and '"tpu"' in line
+    except subprocess.TimeoutExpired:
+        log("bench.py timed out (2400s)")
+        ok = False
+
+    log("running bench_matrix.py (configs 1-2 x strategies 0,1,2)...")
+    try:
+        r = subprocess.run([sys.executable, "bench_matrix.py"],
+                           capture_output=True, text=True, timeout=5400,
+                           cwd=REPO, env=env)
+        rows = [ln for ln in r.stdout.strip().splitlines()
+                if ln.startswith("{")]
+        log(f"bench_matrix.py rc={r.returncode}: {len(rows)} rows")
+        for ln in (r.stderr or "").strip().splitlines():
+            log(f"  matrix: {ln}")
+        if rows:
+            with open(os.path.join(REPO, "BENCH_TPU_MATRIX.jsonl"), "a") as f:
+                stamp = time.strftime("%Y-%m-%dT%H:%M:%S")
+                for ln in rows:
+                    row = json.loads(ln)
+                    row["captured_at"] = stamp
+                    f.write(json.dumps(row) + "\n")
+        ok &= r.returncode == 0 and any('"backend": "tpu"' in ln or
+                                        "'backend': 'tpu'" in ln or
+                                        json.loads(ln).get("backend") == "tpu"
+                                        for ln in rows)
+    except subprocess.TimeoutExpired:
+        log("bench_matrix.py timed out (5400s)")
+        ok = False
+    return ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-h", type=float, default=10.0,
+                    help="give up after this many hours")
+    ap.add_argument("--interval-s", type=float, default=180.0,
+                    help="sleep between failed probes")
+    args = ap.parse_args()
+
+    deadline = time.time() + args.deadline_h * 3600
+    attempt = 0
+    while time.time() < deadline:
+        if os.path.exists(STOP_FILE):
+            log("stop file present; exiting")
+            return 0
+        attempt += 1
+        log(f"probe attempt {attempt}")
+        if probe():
+            if run_benches():
+                log("TPU benches captured; exiting")
+                return 0
+            log("benches incomplete on a live tunnel; retrying once more "
+                "after a short sleep")
+            time.sleep(60)
+            if probe() and run_benches():
+                log("TPU benches captured on retry; exiting")
+                return 0
+            log("retry failed; going back to probing")
+        time.sleep(args.interval_s)
+    log("deadline reached without a live TPU; exiting")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
