@@ -1,0 +1,171 @@
+"""Scale point: DBpedia-shaped synthetic multi-host run (BASELINE configs 3-4 proxy).
+
+The real DBpedia-2016 dump cannot exist in this zero-egress image, so this
+drives the SAME machinery (multi-host sharded ingest with hash-partitioned
+interning + sharded discovery) over a synthetic dataset shaped like it:
+power-law join lines, URI/literal mix, --support high (the reference's
+config-3/4 settings use 1000).  Records wall-clock per phase, peak host RSS,
+exchange overflow retries, and CIND counts — the measured row BASELINE.md
+configs 3-4 cite.
+
+Method: writes N tab-separated triples as 8 shard files, then launches 2
+coordinated processes (4 fake CPU devices each; the minicluster analog with
+real process boundaries) running the CLI with --sharded-ingest; parses each
+worker's counter/phase report.  NB: this box has ONE CPU core — wall-clock
+numbers measure the dataflow on an oversubscribed core, so the artifact's
+honest headline is the MEMORY + correctness bound (per-host RSS vs dataset
+size), with wall-clock reported as-is.
+
+Run:  python bench_scale.py [--n 20000000] [--support 1000] [--strategies 0,1]
+Output: one JSON line per (strategy) run -> append to SCALE_r04.jsonl.
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def write_shards(n: int, seed: int, out_dir: str, n_shards: int = 8):
+    """Synthetic triples -> tab-separated shard files (streamed, bounded RAM)."""
+    sys.path.insert(0, REPO)
+    import numpy as np
+
+    from rdfind_tpu.utils.synth import generate_triples
+
+    os.makedirs(out_dir, exist_ok=True)
+    paths = [os.path.join(out_dir, f"shard{i}.tsv") for i in range(n_shards)]
+    files = [open(p, "w", buffering=1 << 20) for p in paths]
+    chunk = 1_000_000
+    done = 0
+    while done < n:
+        m = min(chunk, n - done)
+        # Independent chunks with distinct seeds: the union keeps the same
+        # power-law shape while generation stays O(chunk) RAM.
+        t = generate_triples(m, seed=seed + done // chunk)
+        shard_of = (np.arange(m) + done) % n_shards
+        for i, f in enumerate(files):
+            rows = t[shard_of == i]
+            f.write("\n".join(
+                f"v{a}\tv{b}\tv{c}" for a, b, c in rows))
+            f.write("\n")
+        done += m
+        print(f"  wrote {done}/{n}", file=sys.stderr, flush=True)
+    for f in files:
+        f.close()
+    return paths
+
+
+def run_two_hosts(paths, support: int, strategy: int, extra=(),
+                  timeout_s: int = 4 * 3600):
+    port = _free_port()
+    outs = []
+    procs = []
+    for pid in range(2):
+        cmd = [sys.executable, "-m", "rdfind_tpu.programs.rdfind",
+               *paths, "--tabs", "--support", str(support),
+               "--traversal-strategy", str(strategy), "--use-fis",
+               "--sharded-ingest", "--counters", "1",
+               "--coordinator", f"127.0.0.1:{port}",
+               "--num-hosts", "2", "--host-index", str(pid), *extra]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=4"
+            " --xla_cpu_collective_timeout_seconds=7200"
+            " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
+            " --xla_cpu_collective_call_terminate_timeout_seconds=7200")
+        procs.append(subprocess.Popen(
+            cmd, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env))
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout_s))
+    finally:
+        # Never orphan multi-GB workers (a killed parent must not leave two
+        # coordinated processes thrashing the box's one core).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        while len(outs) < len(procs):
+            outs.append(procs[len(outs)].communicate())
+    return procs, outs
+
+
+def parse_report(err: str) -> dict:
+    counters, phases = {}, {}
+    for line in err.splitlines():
+        line = line.strip()
+        if line.startswith("phase "):
+            name, ms = line[6:].split(": ")
+            phases[name] = float(ms.rstrip(" ms"))
+        elif ": " in line and not line.startswith(("note", "warning")):
+            k, _, v = line.partition(": ")
+            if v.strip().lstrip("-").isdigit() and " " not in k:
+                counters[k] = int(v)
+    return {"counters": counters, "phases_ms": phases}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=20_000_000)
+    ap.add_argument("--support", type=int, default=1000)
+    ap.add_argument("--strategies", default="0,1")
+    ap.add_argument("--seed", type=int, default=404)
+    ap.add_argument("--data-dir", default="/tmp/rdfind_scale")
+    ap.add_argument("--keep-data", action="store_true")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    marker = os.path.join(args.data_dir, f"ok_{args.n}_{args.seed}")
+    if os.path.exists(marker):
+        paths = [os.path.join(args.data_dir, f"shard{i}.tsv")
+                 for i in range(8)]
+        print(f"reusing shards in {args.data_dir}", file=sys.stderr)
+    else:
+        print(f"writing {args.n} triples...", file=sys.stderr)
+        paths = write_shards(args.n, args.seed, args.data_dir)
+        open(marker, "w").close()
+    gen_s = time.perf_counter() - t0
+
+    for strat in (int(s) for s in args.strategies.split(",")):
+        t0 = time.perf_counter()
+        procs, outs = run_two_hosts(paths, args.support, strat)
+        wall = time.perf_counter() - t0
+        row = {"n_triples": args.n, "support": args.support,
+               "strategy": strat, "wall_s": round(wall, 1),
+               "datagen_s": round(gen_s, 1), "hosts": 2,
+               "box": "1 CPU core, 4 fake devices/host"}
+        for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
+            rep = parse_report(err)
+            row[f"host{pid}"] = {
+                "rc": p.returncode,
+                "peak_rss_mb": rep["counters"].get("peak-rss-mb"),
+                **({"counters": rep["counters"],
+                    "phases_ms": rep["phases_ms"]} if pid == 0 else {}),
+            }
+            if p.returncode != 0:
+                row[f"host{pid}"]["stderr_tail"] = err[-1500:]
+        print(json.dumps(row), flush=True)
+        with open(os.path.join(REPO, "SCALE_r04.jsonl"), "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    if not args.keep_data:
+        print(f"note: shards kept in {args.data_dir} (pass --keep-data to "
+              f"silence; delete manually to reclaim disk)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,9 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import conditions as cc
-from .. import oracle
 from ..data import CindTable
-from ..ops import cooc, frequency, pairs, segments
+from ..ops import cooc, frequency, minimality, pairs, segments
 from ..ops.emission import emit_join_candidates
 
 SENTINEL = segments.SENTINEL
@@ -414,7 +413,7 @@ def _postprocess(table, triples, min_support, use_ars, clean_implied, stats):
             stats["association_rules"] = rules
         table = filter_ar_implied_cinds(table, rules)
     if clean_implied:
-        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+        table = minimality.minimize_table(table)
     return table
 
 

@@ -33,9 +33,8 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-from .. import oracle
 from ..data import CindTable
-from ..ops import frequency, segments, sketch
+from ..ops import frequency, minimality, segments, sketch
 from . import allatonce, small_to_large
 
 DEP_TILE = 1 << 12
@@ -187,5 +186,5 @@ def discover(triples, min_support: int, projections: str = "spo",
             stats["association_rules"] = rules
         table = allatonce.filter_ar_implied_cinds(table, rules)
     if clean_implied:
-        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+        table = minimality.minimize_table(table)
     return table

@@ -26,9 +26,8 @@ from __future__ import annotations
 import numpy as np
 
 from .. import conditions as cc
-from .. import oracle
 from ..data import CindTable
-from ..ops import frequency, sketch
+from ..ops import frequency, minimality, sketch
 from . import allatonce, approximate, small_to_large
 
 
@@ -103,5 +102,5 @@ def discover(triples, min_support: int, projections: str = "spo",
             stats["association_rules"] = rules
         table = allatonce.filter_ar_implied_cinds(table, rules)
     if clean_implied:
-        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+        table = minimality.minimize_table(table)
     return table

@@ -76,9 +76,8 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from .. import conditions as cc
-from .. import oracle
 from ..data import CindTable
-from ..ops import frequency, hashing, pairs, segments
+from ..ops import frequency, hashing, minimality, pairs, segments
 from ..ops.emission import emit_join_candidates
 from ..parallel import exchange
 from ..parallel.mesh import AXIS, host_gather, make_global, make_mesh
@@ -627,6 +626,23 @@ def _shard_triples(triples, num_dev, t_loc: int | None = None):
     return padded, n_valid, t_loc
 
 
+# Largest total exchange buffer (rows) an int32-indexed (D * capacity) scatter
+# can address; beyond it the plan must fail loudly, not wrap (a 60k-triple
+# support-5 smoke found route()'s flat index overflowing instead).
+MAX_EXCHANGE_ROWS = (1 << 31) - 1
+
+
+def _check_exchange_caps(num_dev: int, **caps) -> None:
+    """Planned capacities must keep every (D * capacity) buffer int32-indexable."""
+    for name, c in caps.items():
+        if num_dev * int(c) > MAX_EXCHANGE_ROWS:
+            raise RuntimeError(
+                f"planned exchange capacity {name}={c} x {num_dev} devices "
+                f"exceeds the int32 buffer budget; this workload's pair "
+                f"volume needs more devices, a higher --support, or "
+                f"--use-fis pruning")
+
+
 def _headroom(measured: int, floor: int = CAP_FLOOR) -> int:
     """Measured load -> planned capacity: +12.5% margin, pow2-bucketed (compiled
     programs are reused across runs whose loads land in the same bucket)."""
@@ -684,6 +700,8 @@ class _Pipeline:
                 self.cap_f = segments.pow2_capacity(2 * self.cap_f + int(ovf[0]))
             if ovf[1] > 0:
                 self.cap_a = segments.pow2_capacity(2 * self.cap_a + int(ovf[1]))
+            _check_exchange_caps(self.num_dev, freq=self.cap_f,
+                                 exchange_a=self.cap_a)
         else:
             raise RuntimeError(
                 f"line-building overflow persisted after {max_retries} retries "
@@ -696,6 +714,9 @@ class _Pipeline:
         self.cap_g = _headroom(plan[2])
         self.cap_gp = _headroom(2 * int(plan[3]), floor=1 << 10)
         self.cap_c = segments.pow2_capacity(self.cap_p + self.cap_gp)
+        _check_exchange_caps(self.num_dev, exchange_b=self.cap_b,
+                             pairs=self.cap_p, giant_rows=self.cap_g,
+                             giant_pairs=self.cap_gp, exchange_c=self.cap_c)
 
         # P2b: load-aware placement of the measured hot tail.
         self._maybe_rebalance()
@@ -807,6 +828,9 @@ class _Pipeline:
             self.cap_g = segments.pow2_capacity(2 * self.cap_g + int(ovf[2]))
         if ovf[3] > 0:
             self.cap_gp = segments.pow2_capacity(2 * self.cap_gp + int(ovf[3]))
+        _check_exchange_caps(self.num_dev, pairs=self.cap_p,
+                             exchange_c=self.cap_c, giant_rows=self.cap_g,
+                             giant_pairs=self.cap_gp)
 
     def collect_blocks(self, cols, n_out):
         """Per-device compacted outputs -> host rows."""
@@ -941,7 +965,7 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
             stats["association_rules"] = rules
         table = allatonce.filter_ar_implied_cinds(table, rules)
     if clean_implied:
-        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+        table = minimality.minimize_table_sharded(table, mesh)
     return table
 
 
@@ -1141,13 +1165,31 @@ def _sharded_sketch_candidates(pipe, cap_table, bits, num_hashes, stats):
     return d.astype(np.int64), r.astype(np.int64)
 
 
+def _check_preshard(triples, preshard, use_ars, use_fis):
+    """Shared entry validation: host table XOR preshard global arrays.
+
+    With `preshard` (sharded multi-host ingest) no host holds the triple
+    table, so AR mining — which needs host rows — is rejected, matching
+    discover_sharded.  Returns (triples-as-int32-or-None, use_ars)."""
+    if preshard is not None:
+        if use_ars and use_fis:
+            raise ValueError("use_ars requires a host triple table; "
+                             "unsupported with preshard")
+        return None, False
+    triples = np.asarray(triples, np.int32)
+    if triples.shape[0] == 0:
+        return None, use_ars and use_fis
+    return triples, use_ars and use_fis
+
+
 def _sharded_prep_approx(triples, min_support, mesh, projections, use_fis,
                          use_ars, max_retries, sketch_bits, sketch_hashes,
-                         stats, skew=None, combine=True):
+                         stats, skew=None, combine=True, preshard=None):
     """Shared setup for sharded strategies 2/3: pipeline, frequent-capture
     table, sketch candidates, and the sharded verification backend."""
     pipe = _Pipeline(mesh, triples, min_support, projections, use_fis, use_ars,
-                     max_retries, stats, skew=skew, combine=combine)
+                     max_retries, stats, skew=skew, combine=combine,
+                     preshard=preshard)
     cap_code, cap_v1, cap_v2, dep_count = pipe.capture_table()
     freq_cap = dep_count >= min_support
     cap_table = tuple(a[freq_cap] for a in (cap_code, cap_v1, cap_v2,
@@ -1155,7 +1197,9 @@ def _sharded_prep_approx(triples, min_support, mesh, projections, use_fis,
     if cap_table[0].shape[0] == 0:
         return None
     if stats is not None:
-        stats.update(n_triples=triples.shape[0],
+        n_triples = (triples.shape[0] if preshard is None
+                     else int(host_gather(preshard[1]).sum()))
+        stats.update(n_triples=n_triples,
                      n_captures=int(cap_table[0].shape[0]), total_pairs=0)
     cand_dep, cand_ref = _sharded_sketch_candidates(
         pipe, cap_table, sketch_bits, sketch_hashes, stats)
@@ -1164,7 +1208,7 @@ def _sharded_prep_approx(triples, min_support, mesh, projections, use_fis,
 
 
 def _finish_table(cap_table, d, r, sup, triples, min_support, use_ars,
-                  clean_implied, stats):
+                  clean_implied, stats, mesh=None):
     from . import allatonce
 
     cap_code, cap_v1, cap_v2, _ = cap_table
@@ -1178,7 +1222,8 @@ def _finish_table(cap_table, d, r, sup, triples, min_support, use_ars,
             stats["association_rules"] = rules
         table = allatonce.filter_ar_implied_cinds(table, rules)
     if clean_implied:
-        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+        table = (minimality.minimize_table_sharded(table, mesh)
+                 if mesh is not None else minimality.minimize_table(table))
     return table
 
 
@@ -1189,7 +1234,8 @@ def discover_sharded_approx(triples, min_support: int, mesh=None,
                             sketch_hashes: int = 4,
                             stats: dict | None = None,
                             skew: SkewPolicy | None = None,
-                            combine: bool = True) -> CindTable:
+                            combine: bool = True,
+                         preshard=None) -> CindTable:
     """Sharded ApproximateAllAtOnce (strategy 2): mesh-tiled sketch containment
     for candidates, exact sharded counting for verification.  Output is
     identical to models.approximate.discover (= raw AllAtOnce)."""
@@ -1197,16 +1243,17 @@ def discover_sharded_approx(triples, min_support: int, mesh=None,
 
     if mesh is None:
         mesh = make_mesh()
-    triples = np.asarray(triples, np.int32)
-    if triples.shape[0] == 0 or not any(ch in projections for ch in "spo"):
+    triples, use_ars = _check_preshard(triples, preshard, use_ars, use_fis)
+    if triples is None and preshard is None:
+        return CindTable.empty()
+    if not any(ch in projections for ch in "spo"):
         return CindTable.empty()
     min_support = max(int(min_support), 1)
-    use_ars = use_ars and use_fis
 
     prep = _sharded_prep_approx(triples, min_support, mesh, projections,
                                 use_fis, use_ars, max_retries, sketch_bits,
                                 sketch_hashes, stats, skew=skew,
-                                combine=combine)
+                                combine=combine, preshard=preshard)
     if prep is None:
         return CindTable.empty()
     cap_table, cand_dep, cand_ref, backend = prep
@@ -1215,7 +1262,7 @@ def discover_sharded_approx(triples, min_support: int, mesh=None,
         backend.cooc, cand_dep, cand_ref, cap_code.shape[0], dep_count,
         cap_code, cap_v1, cap_v2, min_support, "pairs_verify")
     return _finish_table(cap_table, d, r, sup, triples, min_support, use_ars,
-                         clean_implied, stats)
+                         clean_implied, stats, mesh=mesh)
 
 
 def discover_sharded_late_bb(triples, min_support: int, mesh=None,
@@ -1225,7 +1272,8 @@ def discover_sharded_late_bb(triples, min_support: int, mesh=None,
                              sketch_hashes: int = 4,
                              stats: dict | None = None,
                             skew: SkewPolicy | None = None,
-                            combine: bool = True) -> CindTable:
+                            combine: bool = True,
+                         preshard=None) -> CindTable:
     """Sharded LateBB (strategy 3): one mesh-tiled sketch pass, then the
     unary-dependent round and the 1/x-pruned binary round verify on the mesh.
     Output is identical to models.late_bb.discover."""
@@ -1233,16 +1281,17 @@ def discover_sharded_late_bb(triples, min_support: int, mesh=None,
 
     if mesh is None:
         mesh = make_mesh()
-    triples = np.asarray(triples, np.int32)
-    if triples.shape[0] == 0 or not any(ch in projections for ch in "spo"):
+    triples, use_ars = _check_preshard(triples, preshard, use_ars, use_fis)
+    if triples is None and preshard is None:
+        return CindTable.empty()
+    if not any(ch in projections for ch in "spo"):
         return CindTable.empty()
     min_support = max(int(min_support), 1)
-    use_ars = use_ars and use_fis
 
     prep = _sharded_prep_approx(triples, min_support, mesh, projections,
                                 use_fis, use_ars, max_retries, sketch_bits,
                                 sketch_hashes, stats, skew=skew,
-                                combine=combine)
+                                combine=combine, preshard=preshard)
     if prep is None:
         return CindTable.empty()
     cap_table, cand_dep, cand_ref, backend = prep
@@ -1264,7 +1313,7 @@ def discover_sharded_late_bb(triples, min_support: int, mesh=None,
     return _finish_table(
         cap_table, np.concatenate([d1, d2]), np.concatenate([r1, r2]),
         np.concatenate([sup1, sup2]), triples, min_support, use_ars,
-        clean_implied, stats)
+        clean_implied, stats, mesh=mesh)
 
 
 def discover_sharded_s2l(triples, min_support: int, mesh=None,
@@ -1273,7 +1322,8 @@ def discover_sharded_s2l(triples, min_support: int, mesh=None,
                          max_retries: int = 4,
                          stats: dict | None = None,
                          skew: SkewPolicy | None = None,
-                         combine: bool = True) -> CindTable:
+                         combine: bool = True,
+                         preshard=None) -> CindTable:
     """Sharded SmallToLarge: the reference's default strategy on the mesh.
 
     Join lines are built once and stay device-resident; the host drives the
@@ -1285,15 +1335,16 @@ def discover_sharded_s2l(triples, min_support: int, mesh=None,
 
     if mesh is None:
         mesh = make_mesh()
-    triples = np.asarray(triples, np.int32)
-    n = triples.shape[0]
-    if n == 0 or not any(ch in projections for ch in "spo"):
+    triples, use_ars = _check_preshard(triples, preshard, use_ars, use_fis)
+    if triples is None and preshard is None:
+        return CindTable.empty()
+    if not any(ch in projections for ch in "spo"):
         return CindTable.empty()
     min_support = max(int(min_support), 1)
-    use_ars = use_ars and use_fis
 
     pipe = _Pipeline(mesh, triples, min_support, projections, use_fis, use_ars,
-                     max_retries, stats, skew=skew, combine=combine)
+                     max_retries, stats, skew=skew, combine=combine,
+                     preshard=preshard)
     cap_code, cap_v1, cap_v2, dep_count = pipe.capture_table()
     # Frequent captures only (the single-device capture filter; infrequent ones
     # can appear in no CIND on either side).
@@ -1305,7 +1356,9 @@ def discover_sharded_s2l(triples, min_support: int, mesh=None,
         return CindTable.empty()
 
     if stats is not None:
-        stats.update(n_triples=n, n_captures=num_caps, total_pairs=0)
+        n_triples = (triples.shape[0] if preshard is None
+                     else int(host_gather(pipe._n_valid).sum()))
+        stats.update(n_triples=n_triples, n_captures=num_caps, total_pairs=0)
 
     backend = _ShardedCooc(pipe, (cap_code, cap_v1, cap_v2, dep_count))
 
@@ -1316,4 +1369,4 @@ def discover_sharded_s2l(triples, min_support: int, mesh=None,
 
     return small_to_large._run_lattice(
         backend.cooc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
-        min_support, use_ars, rules, clean_implied, stats)
+        min_support, use_ars, rules, clean_implied, stats, mesh=pipe.mesh)

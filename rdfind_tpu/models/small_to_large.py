@@ -45,10 +45,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import conditions as cc
-from .. import oracle
 from ..data import NO_VALUE, CindTable
 from ..ops import cooc as cooc_ops
-from ..ops import frequency, pairs, segments, sketch
+from ..ops import frequency, minimality, pairs, segments, sketch
 from . import allatonce
 
 SENTINEL = segments.SENTINEL
@@ -614,7 +613,7 @@ def _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
             stats.update(n_cinds_12=0, n_cinds_21=0, n_inferred_21=0,
                          n_cinds_22=0)
         if clean_implied:
-            table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+            table = minimality.minimize_table(table)
         return table
     b_pad = segments.pow2_capacity(nb)
     s1_h = _lookup_capture_ids(
@@ -672,7 +671,7 @@ def _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
         ref_code=cap_code[all_r], ref_v1=cap_v1[all_r], ref_v2=cap_v2[all_r],
         support=all_s)
     if clean_implied:
-        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+        table = minimality.minimize_table(table)
     return table
 
 
@@ -924,7 +923,7 @@ def discover(triples, min_support: int, projections: str = "spo",
 
 def _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count, num_caps,
                  min_support, use_ars, rules, clean_implied,
-                 stats, cooc_fn_11=None) -> CindTable:
+                 stats, cooc_fn_11=None, mesh=None) -> CindTable:
     """The S2L lattice walk, generic over the verification backend.
 
     cooc_fn(dep_ok, ref_ok, stat_key) -> (dep_id, ref_id, count): global merged
@@ -1047,7 +1046,8 @@ def _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count, num_caps,
         ref_code=cap_code[all_r], ref_v1=cap_v1[all_r], ref_v2=cap_v2[all_r],
         support=all_s)
     if clean_implied:
-        table = CindTable.from_rows(oracle.minimize_cinds(table.to_rows()))
+        table = (minimality.minimize_table_sharded(table, mesh)
+                 if mesh is not None else minimality.minimize_table(table))
     return table
 
 

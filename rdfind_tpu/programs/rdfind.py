@@ -125,7 +125,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sharded-ingest", action="store_true",
                    help="each host parses only its file subset and donates "
                         "rows to its own devices (multi-host; no host holds "
-                        "the full triple table; strategy 0 only)")
+                        "the full triple table; all four traversal "
+                        "strategies run on the presharded arrays)")
+    p.add_argument("--interning", choices=("auto", "partitioned",
+                                           "replicated"), default="auto",
+                   help="sharded-ingest dictionary mode: partitioned = each "
+                        "host stores only its value-hash range (multi-host "
+                        "default; decode is a collective), replicated = "
+                        "every host holds the union; auto picks partitioned "
+                        "when multi-host")
     p.add_argument("--no-native-ingest", action="store_true",
                    help="force the pure-Python ingest path")
     p.add_argument("--checkpoint-dir", default=None,
@@ -156,6 +164,13 @@ def main(argv=None) -> int:
         parser.error("--num-hosts/--host-index require --coordinator "
                      "(without it this would run a full independent "
                      "single-host job)")
+    if os.environ.get("JAX_PLATFORMS"):
+        # Make the env request effective: this image's sitecustomize force-
+        # sets jax_platforms at interpreter start, so an explicit env pin
+        # (e.g. JAX_PLATFORMS=cpu for minicluster runs while the TPU tunnel
+        # is held elsewhere) must be re-applied via the config.
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     if args.coordinator:
         # Join the multi-host runtime before anything touches the backend;
         # the mesh then spans every host's devices and --dop defaults to all
@@ -211,6 +226,7 @@ def main(argv=None) -> int:
         find_only_fcs=args.find_only_fcs,
         create_join_histogram=args.create_join_histogram,
         sharded_ingest=args.sharded_ingest,
+        interning=args.interning,
     )
     # Un-silence the remaining compatibility no-ops (the reference's
     # JVM-dataflow levers that the TPU design subsumes).

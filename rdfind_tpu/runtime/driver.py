@@ -67,6 +67,7 @@ class Config:
     find_only_fcs: int = 0  # >=1: stop after frequent-condition mining
     create_join_histogram: bool = False  # print join-line size histogram
     sharded_ingest: bool = False  # each host parses only its file subset
+    interning: str = "auto"  # sharded-ingest dictionary: partitioned|replicated
 
 
 @dataclasses.dataclass
@@ -245,8 +246,10 @@ _STRATEGY_PLANS = {
 def describe_plan(cfg: Config) -> dict:
     """A JSON-able description of the stages this config will execute."""
     if cfg.sharded_ingest:
-        pre = ["sharded-ingest (per-host parse+intern, global dictionary "
-               "exchange, per-device row donation)"]
+        mode = ("replicated dictionary exchange" if cfg.interning == "replicated"
+                else "hash-partitioned interning")
+        pre = [f"sharded-ingest (per-host parse+intern, {mode}, "
+               "per-device row donation)"]
     else:
         pre = ["read+parse"]
         if cfg.asciify_triples:
@@ -319,7 +322,6 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
     """Multi-host sharded ingest + preshard discovery (each host parses only
     its file subset; no host materializes the full triple table)."""
     unsupported = [
-        (cfg.traversal_strategy != 0, "--traversal-strategy != 0"),
         (cfg.checkpoint_dir is not None, "--checkpoint-dir"),
         (cfg.asciify_triples, "--asciify-triples"),
         (bool(cfg.prefix_paths), "--prefixes"),
@@ -344,7 +346,9 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
     def ingest():
         return multihost_ingest.sharded_ingest(
             paths, mesh, tabs=cfg.tabs, expect_quad=is_nq,
-            encoding=cfg.encoding, use_native=cfg.native_ingest)
+            encoding=cfg.encoding, use_native=cfg.native_ingest,
+            partition_dictionary={"auto": None, "partitioned": True,
+                                  "replicated": False}[cfg.interning])
 
     g_triples, g_valid, dictionary, total = phases.run("sharded-ingest",
                                                        ingest)
@@ -353,7 +357,19 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
 
     stats: dict = {}
     skew = _skew_from_cfg(cfg)
-    table = phases.run("discover", lambda: sharded.discover_sharded(
+    # Strategy dispatch over the preshard — all four families run natively on
+    # the pre-built global arrays (the reference's default strategy is fully
+    # distributed too, plan/SmallToLargeTraversalStrategy.scala:38-171).
+    discover_fn = {
+        0: sharded.discover_sharded,
+        1: sharded.discover_sharded_s2l,
+        2: sharded.discover_sharded_approx,
+        3: sharded.discover_sharded_late_bb,
+    }.get(cfg.traversal_strategy)
+    if discover_fn is None:
+        raise ValueError(
+            f"unknown traversal strategy {cfg.traversal_strategy}")
+    table = phases.run("discover", lambda: discover_fn(
         None, cfg.min_support, mesh=mesh, skew=skew,
         combine=cfg.combinable_join, projections=cfg.projections,
         use_fis=cfg.use_frequent_item_set,
@@ -361,6 +377,12 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
         preshard=(g_triples, g_valid)))
     counters["cind-counter"] = len(table)
     counters.update({f"stat-{k}": v for k, v in stats.items()})
+    if isinstance(dictionary, multihost_ingest.PartitionedDictionary):
+        # Hash-partitioned interning: no host holds the union, so decoding the
+        # final CINDs is a collective every host joins (the strings needed are
+        # only the output's condition values — tiny next to the dictionary).
+        dictionary = phases.run("resolve-dictionary",
+                                lambda: dictionary.resolve_table(table))
     _emit_sinks(cfg, phases, counters, table, dictionary, stats, None)
     _report(cfg, counters, phases.timings)
     return RunResult(table, dictionary, None, counters, phases.timings)
@@ -681,7 +703,17 @@ def _emit_sinks(cfg: Config, phases: _Phases, counters: dict, table,
 
 def _report(cfg: Config, counters: dict, timings: dict) -> None:
     """Post-run statistics, incl. the CSV line (AbstractFlinkProgram.java:149-182)."""
+    try:
+        import resource
+        counters["peak-rss-mb"] = (
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024)
+    except Exception:
+        pass
     if not _is_primary():
+        if cfg.counter_level >= 1 and "peak-rss-mb" in counters:
+            # Worker hosts report their own memory high-water (the scale
+            # artifact needs every host's bound, not just host 0's).
+            print(f"peak-rss-mb: {counters['peak-rss-mb']}", file=sys.stderr)
         return
     if cfg.counter_level >= 1:
         for k, v in sorted(counters.items()):

@@ -10,14 +10,17 @@ collision-free), remap local ids, and donate their triple rows directly to
 their own devices as one jax global array — no host ever materializes the
 full triple table.
 
-Value-set exchange budget: the union of distinct values is replicated on
-every host (numpy strings), i.e. O(global dictionary) host RAM — the same
-budget class as the capture table (models/sharded.capture_table).  Beyond
-that scale the next step is hash-partitioned interning (each host owns a
-value-hash range); the triple table itself already never leaves its host.
+Dictionary budget: by default (multi-host) interning is HASH-PARTITIONED —
+each host owns a crc32 range of values and stores only that range
+(`partitioned_intern`), so steady host RAM is O(local distinct + own range),
+never the union.  `partition_dictionary=False` keeps the replicated
+`Dictionary` (every host holds the union) for differential testing and for
+consumers that need collective-free decoding.
 """
 
 from __future__ import annotations
+
+import dataclasses
 
 import numpy as np
 
@@ -50,17 +53,14 @@ def _local_ingest(paths, tabs: bool, expect_quad: bool, encoding,
     return intern_triples(np.asarray(rows, dtype=object))
 
 
-def _allgather_values(local_values: np.ndarray) -> np.ndarray:
-    """Union of every host's distinct values, identical on every host.
+def _allgather_str_arrays(local_values) -> list[np.ndarray]:
+    """Every host's value array, as a list indexed by host.
 
     Strings travel as one UTF-8 blob + offsets, padded to the global max so
     process_allgather sees fixed shapes.
     """
-    import jax
     from jax.experimental import multihost_utils
 
-    if jax.process_count() == 1:
-        return np.asarray(local_values, object)
     encoded = [str(v).encode("utf-8") for v in local_values]
     blob = b"".join(encoded)
     offsets = np.zeros(len(encoded) + 1, np.int64)
@@ -77,25 +77,183 @@ def _allgather_values(local_values: np.ndarray) -> np.ndarray:
     all_blobs = np.asarray(multihost_utils.process_allgather(blob_pad))
     all_offs = np.asarray(multihost_utils.process_allgather(offs_pad))
 
-    values = []
+    out = []
     for h in range(all_sizes.shape[0]):
         offs = all_offs[h]
         offs = offs[offs >= 0]
         raw = all_blobs[h].tobytes()
-        values.extend(raw[offs[i]:offs[i + 1]].decode("utf-8")
-                      for i in range(len(offs) - 1))
-    return np.unique(np.asarray(values, object))
+        out.append(np.asarray(
+            [raw[offs[i]:offs[i + 1]].decode("utf-8")
+             for i in range(len(offs) - 1)], object))
+    return out
+
+
+def _allgather_values(local_values: np.ndarray) -> np.ndarray:
+    """Union of every host's distinct values, identical on every host."""
+    import jax
+
+    if jax.process_count() == 1:
+        return np.asarray(local_values, object)
+    gathered = _allgather_str_arrays(local_values)
+    return np.unique(np.concatenate(gathered)) if gathered else \
+        np.zeros(0, object)
+
+
+# ---------------------------------------------------------------------------
+# Hash-partitioned interning: each host owns a value-hash range.
+# ---------------------------------------------------------------------------
+
+
+def _value_owner(values, num_hosts: int) -> np.ndarray:
+    """Deterministic owner host per value (crc32 — identical on every host)."""
+    import zlib
+
+    return np.fromiter(
+        (zlib.crc32(str(v).encode("utf-8")) % num_hosts for v in values),
+        np.int64, count=len(values))
+
+
+@dataclasses.dataclass
+class PartitionedDictionary:
+    """Global dictionary with host-partitioned storage.
+
+    Host h stores only the values whose crc32 hashes to it; their global ids
+    are ``offsets[h] + rank within the owner's sorted range``.  No host ever
+    materializes the union — the reference avoids the same wall by streaming
+    raw strings through its shuffles with optional hash compression
+    (RDFind.scala:274-282, operators/CreateHashes.scala:40-57); here ids stay
+    exact and collision-free, but their strings live with their hash owner.
+
+    Decoding therefore needs a collective: `resolve(ids)` returns a
+    ResolvedDictionary view covering just those ids (every host must call it —
+    sinks only need the final CIND values, which are tiny).
+    """
+
+    offsets: np.ndarray   # (H+1,) int64: global-id range start per owner host
+    own_values: np.ndarray  # sorted distinct values owned by THIS host
+    host_index: int
+    num_hosts: int
+
+    def __len__(self) -> int:
+        return int(self.offsets[-1])
+
+    def value(self, idx: int):
+        lo = int(self.offsets[self.host_index])
+        hi = int(self.offsets[self.host_index + 1])
+        if not lo <= int(idx) < hi:
+            raise KeyError(
+                f"id {idx} is owned by another host; use resolve(ids) "
+                f"(a collective) to decode across hash ranges")
+        return self.own_values[int(idx) - lo]
+
+    def resolve(self, ids) -> "ResolvedDictionary":
+        """Collective: id -> string view for `ids` (every host must call)."""
+        ids = np.unique(np.asarray(ids, np.int64).reshape(-1))
+        ids = ids[(ids >= 0) & (ids < len(self))]
+        lo = int(self.offsets[self.host_index])
+        hi = int(self.offsets[self.host_index + 1])
+        mine = ids[(ids >= lo) & (ids < hi)]
+        mine_vals = self.own_values[mine - lo]
+
+        import jax
+        from jax.experimental import multihost_utils
+
+        if jax.process_count() == 1:
+            all_ids, all_vals = [mine], [mine_vals]
+        else:
+            n = len(mine)
+            sizes = np.asarray(multihost_utils.process_allgather(
+                np.asarray([n], np.int64))).reshape(-1)
+            pad = np.full(max(int(sizes.max()), 1), -1, np.int64)
+            pad[:n] = mine
+            all_id_mat = np.asarray(multihost_utils.process_allgather(pad))
+            all_ids = [row[row >= 0] for row in all_id_mat]
+            all_vals = _allgather_str_arrays(mine_vals)
+        mapping = {}
+        for id_arr, val_arr in zip(all_ids, all_vals):
+            mapping.update(zip(id_arr.tolist(), val_arr.tolist()))
+        return ResolvedDictionary(mapping, len(self))
+
+    def resolve_table(self, table) -> "ResolvedDictionary":
+        """Collective: the view covering a CindTable's condition values."""
+        return self.resolve(np.concatenate([
+            np.asarray(c, np.int64) for c in
+            (table.dep_v1, table.dep_v2, table.ref_v1, table.ref_v2)]))
+
+
+@dataclasses.dataclass
+class ResolvedDictionary:
+    """Materialized id -> string view over a (small) id subset."""
+
+    mapping: dict
+    size: int
+
+    def __len__(self) -> int:
+        return self.size
+
+    def value(self, idx: int):
+        return self.mapping[int(idx)]
+
+
+def partitioned_intern(local_values, num_hosts: int, host_index: int):
+    """Agree on global ids without replicating the dictionary.
+
+    local_values: this host's sorted distinct values (object array).
+    Returns (global_ids aligned with local_values (int64), PartitionedDictionary).
+
+    One owner round per host: requesters allgather the values hashing to the
+    round's owner (transient — non-owners drop them immediately), the owner
+    dedupes its range and shares the deduped range back; every host ranks its
+    own requests locally by searchsorted.  After all rounds a counts
+    allgather fixes the range offsets, and global id = offset + rank.
+    Steady host RAM: O(local distinct + own range), never the union; the
+    transient window is one range wide.
+    """
+    from jax.experimental import multihost_utils
+
+    local_values = np.asarray(local_values, object)
+    owner = _value_owner(local_values, num_hosts)
+    ranks = np.zeros(len(local_values), np.int64)
+    own_values = np.zeros(0, object)
+
+    for g in range(num_hosts):
+        sel = np.flatnonzero(owner == g)
+        req = local_values[sel]  # already sorted+distinct (subset of sorted)
+        all_req = _allgather_str_arrays(req)
+        if host_index == g:
+            own_values = (np.unique(np.concatenate(all_req))
+                          if sum(len(a) for a in all_req)
+                          else np.zeros(0, object))
+        del all_req
+        # Owner shares its deduped sorted range (only g contributes rows);
+        # requesters rank locally — O(H * range) traffic, no H^2 reply matrix.
+        range_vals = _allgather_str_arrays(
+            own_values if host_index == g else np.zeros(0, object))[g]
+        ranks[sel] = np.searchsorted(range_vals, req)
+        del range_vals
+
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([len(own_values)], np.int64))).reshape(-1)
+    offsets = np.zeros(num_hosts + 1, np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    global_ids = ranks + offsets[owner]
+    return global_ids, PartitionedDictionary(
+        offsets=offsets, own_values=own_values,
+        host_index=host_index, num_hosts=num_hosts)
 
 
 def sharded_ingest(paths: list[str], mesh, *, tabs: bool = False,
                    expect_quad: bool = False, encoding="utf-8",
-                   use_native: bool = True):
+                   use_native: bool = True,
+                   partition_dictionary: bool | None = None):
     """Multi-host ingest over `mesh`.
 
     Returns (global_triples, global_n_valid, dictionary, total_triples):
     `global_triples` is a (D * t_loc, 3) int32 jax Array row-sharded over the
-    mesh where each host donated only its own rows; `dictionary` is the
-    identical global Dictionary on every host.
+    mesh where each host donated only its own rows; `dictionary` is a
+    PartitionedDictionary (multi-host default: each host stores only its
+    crc32 hash range — decode via its collective `resolve`) or, with
+    ``partition_dictionary=False`` / single-host, the replicated Dictionary.
     """
     import jax
     from jax.experimental import multihost_utils
@@ -112,13 +270,22 @@ def sharded_ingest(paths: list[str], mesh, *, tabs: bool = False,
     local_ids, local_dict = _local_ingest(my_paths, tabs, expect_quad,
                                           encoding, use_native)
 
-    # One global dictionary, computed identically on every host.
-    global_values = _allgather_values(local_dict.values)
-    dictionary = Dictionary(global_values)
-    if len(local_dict):
-        remap = np.searchsorted(global_values, local_dict.values).astype(
-            np.int32)
-        local_ids = remap[local_ids]
+    if partition_dictionary is None:
+        partition_dictionary = num_hosts > 1
+    if partition_dictionary and num_hosts > 1:
+        # Hash-partitioned global ids: no host materializes the union.
+        gids, dictionary = partitioned_intern(local_dict.values, num_hosts,
+                                              host_index)
+        if len(local_dict):
+            local_ids = gids.astype(np.int32)[local_ids]
+    else:
+        # One replicated global dictionary, computed identically on every host.
+        global_values = _allgather_values(local_dict.values)
+        dictionary = Dictionary(global_values)
+        if len(local_dict):
+            remap = np.searchsorted(global_values, local_dict.values).astype(
+                np.int32)
+            local_ids = remap[local_ids]
 
     # Per-device layout: the mesh's devices are process-contiguous, so this
     # host's devices own one contiguous row block.  t_loc is agreed globally

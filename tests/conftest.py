@@ -11,6 +11,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "collective_call_terminate" not in os.environ["XLA_FLAGS"]:
+    # One-core box: the in-process CPU communicator CHECK-fails ("stuck")
+    # when heavy per-device work staggers a rendezvous; raise its patience.
+    os.environ["XLA_FLAGS"] += (
+        " --xla_cpu_collective_timeout_seconds=7200"
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=7200")
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:

@@ -1,9 +1,11 @@
 """Worker: sharded multi-host ingest + preshard discovery in a 2-process run.
 
-Each process parses only its own file subset; the hosts exchange distinct
-values for the global dictionary, donate rows to their own devices, and run
-the sharded AllAtOnce over the assembled global array.  Process 0 prints the
-decoded CINDs for the parent to compare against a single-process golden run.
+Each process parses only its own file subset; the hosts agree on global ids
+(hash-partitioned by default, replicated with mode=replicated), donate rows
+to their own devices, and run the sharded AllAtOnce over the assembled global
+array.  Every process prints its DICT line (partition sizes — the parent
+asserts no host stored the union); process 0 prints the decoded CINDs for
+the parent to compare against a single-process golden run.
 """
 
 import json
@@ -16,6 +18,8 @@ def main():
     nproc = int(sys.argv[2])
     port = sys.argv[3]
     paths = sys.argv[4].split(",")
+    mode = sys.argv[5] if len(sys.argv) > 5 else "partitioned"
+    strategy = sys.argv[6] if len(sys.argv) > 6 else "0"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     import jax
@@ -29,9 +33,23 @@ def main():
     mesh_mod.initialize_multihost(f"127.0.0.1:{port}", nproc, pid)
     mesh = mesh_mod.make_mesh()
     g_triples, g_valid, dictionary, total = multihost_ingest.sharded_ingest(
-        paths, mesh)
-    table = sharded.discover_sharded(None, 1, mesh=mesh,
-                                     preshard=(g_triples, g_valid))
+        paths, mesh, partition_dictionary=(mode == "partitioned"))
+    discover_fn = {"0": sharded.discover_sharded,
+                   "1": sharded.discover_sharded_s2l,
+                   "2": sharded.discover_sharded_approx,
+                   "3": sharded.discover_sharded_late_bb}[strategy]
+    table = discover_fn(None, 1, mesh=mesh, preshard=(g_triples, g_valid))
+    if isinstance(dictionary, multihost_ingest.PartitionedDictionary):
+        print("DICT " + json.dumps(
+            {"size": len(dictionary),
+             "own": int(len(dictionary.own_values)),
+             "offsets": dictionary.offsets.tolist()}), flush=True)
+        # Collective decode of just the output's condition values.
+        dictionary = dictionary.resolve_table(table)
+    else:
+        print("DICT " + json.dumps(
+            {"size": len(dictionary), "own": int(len(dictionary.values))}),
+            flush=True)
     if pid == 0:
         out = sorted(c.pretty() for c in table.decoded(dictionary))
         print("TOTAL " + str(total), flush=True)

@@ -53,12 +53,16 @@ def _check_seed(seed, min_support):
         assert got == base, f"s2l variant {kw} seed={seed}"
 
 
-@pytest.mark.parametrize("seed", range(3))
+# Default tier: >= 10 seeds (VERDICT r3) — cheap because every seed shares
+# one compiled program per strategy (pinned N_TRIPLES -> equal pow2
+# capacities; min_support is a traced argument, so varying it recompiles
+# nothing).
+@pytest.mark.parametrize("seed", range(10))
 def test_fuzz_strategies(seed):
-    _check_seed(seed, min_support=2)
+    _check_seed(seed, min_support=2 if seed < 5 else 1 + seed % 3)
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("seed", range(3, 15))
+@pytest.mark.parametrize("seed", range(10, 22))
 def test_fuzz_strategies_extended(seed):
     _check_seed(seed, min_support=1 + seed % 3)

@@ -73,37 +73,91 @@ NT_SHARDS = [
 ]
 
 
-def test_two_process_sharded_ingest(tmp_path):
-    """Each host parses only its file subset; the global dictionary and the
-    discovery output must equal a single-process run over all files."""
-    paths = []
-    for i, content in enumerate(NT_SHARDS):
-        p = tmp_path / f"shard{i}.nt"
-        p.write_text(content)
-        paths.append(str(p))
-
+def _run_ingest_workers(paths, mode: str, strategy: str = "0"):
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     procs = [subprocess.Popen(
         [sys.executable,
          os.path.join(_REPO, "tests", "multihost_ingest_worker.py"),
-         str(pid), "2", str(port), ",".join(paths)],
+         str(pid), "2", str(port), ",".join(paths), mode, strategy],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
         for pid in range(2)]
     outs = [p.communicate(timeout=540) for p in procs]
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
     lines = dict(l.split(" ", 1) for l in outs[0][0].splitlines()
-                 if l.startswith(("TOTAL", "CINDS")))
+                 if l.startswith(("TOTAL", "CINDS", "DICT")))
+    dicts = [json.loads(l.split(" ", 1)[1]) for out, _ in outs
+             for l in out.splitlines() if l.startswith("DICT ")]
+    return lines, dicts
 
+
+def _ingest_golden(paths, strategy: str = "0"):
     # Golden: single-process ingest of all files + single-device discovery
     # (same ingest selection as the workers: native when available).
-    from rdfind_tpu.io import native
-    from rdfind_tpu.models import allatonce
+    from rdfind_tpu.models import (allatonce, approximate, late_bb,
+                                   small_to_large)
     from rdfind_tpu.runtime import multihost_ingest
     ids, d = multihost_ingest._local_ingest(paths, False, False, "utf-8")
+    fn = {"0": allatonce.discover, "1": small_to_large.discover,
+          "2": approximate.discover, "3": late_bb.discover}[strategy]
+    want = sorted(c.pretty() for c in fn(ids, 1).decoded(d))
+    return ids, len(d), want
+
+
+@pytest.mark.parametrize("mode", ["partitioned", "replicated"])
+def test_two_process_sharded_ingest(tmp_path, mode):
+    """Each host parses only its file subset; the discovery output must equal
+    a single-process run over all files — under both interning modes, which
+    is the differential pair (hash-partitioned vs replicated dictionary)."""
+    paths = []
+    for i, content in enumerate(NT_SHARDS):
+        p = tmp_path / f"shard{i}.nt"
+        p.write_text(content)
+        paths.append(str(p))
+
+    lines, dicts = _run_ingest_workers(paths, mode)
+    ids, n_distinct, want = _ingest_golden(paths)
     assert int(lines["TOTAL"]) == ids.shape[0]
-    want = sorted(c.pretty()
-                  for c in allatonce.discover(ids, 1).decoded(d))
     assert json.loads(lines["CINDS"]) == want
+
+    assert all(d["size"] == n_distinct for d in dicts)
+    if mode == "partitioned":
+        # The hash ranges PARTITION the dictionary: they sum to the global
+        # size and (both processes' DICT lines agreeing on offsets) no host
+        # stored the union.
+        assert sum(d["own"] for d in dicts) == n_distinct
+        assert dicts[0]["offsets"] == dicts[1]["offsets"]
+        assert all(d["own"] < n_distinct for d in dicts)
+    else:
+        # Replicated mode: every host holds the union.
+        assert all(d["own"] == n_distinct for d in dicts)
+
+
+# Strategy 1 (the reference's default) stays in the default tier; 2/3 are
+# compile-heavy 2-process runs and ride the slow tier like the other
+# multi-mesh invariance tests.
+def _check_ingest_strategy(tmp_path, strategy):
+    paths = []
+    for i, content in enumerate(NT_SHARDS):
+        p = tmp_path / f"shard{i}.nt"
+        p.write_text(content)
+        paths.append(str(p))
+    lines, _ = _run_ingest_workers(paths, "partitioned", strategy)
+    ids, _, want = _ingest_golden(paths, strategy)
+    assert int(lines["TOTAL"]) == ids.shape[0]
+    assert json.loads(lines["CINDS"]) == want
+
+
+def test_two_process_sharded_ingest_s2l(tmp_path):
+    """--sharded-ingest now runs the default strategy end-to-end: preshard
+    global arrays feed the sharded S2L lattice, output equal to the
+    single-process small_to_large run."""
+    _check_ingest_strategy(tmp_path, "1")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("strategy", ["2", "3"])
+def test_two_process_sharded_ingest_approx_latebb(tmp_path, strategy):
+    _check_ingest_strategy(tmp_path, strategy)

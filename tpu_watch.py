@@ -68,10 +68,13 @@ def run_benches() -> bool:
                            text=True, timeout=2400, cwd=REPO, env=env)
         line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
         log(f"bench.py rc={r.returncode}: {line[:200]}")
-        if line:
+        on_tpu = r.returncode == 0 and '"backend": "tpu"' in line
+        if on_tpu:
+            # Only a real-TPU row may become the headline artifact (a CPU
+            # fallback exiting rc=0 must not masquerade as the TPU number).
             with open(os.path.join(REPO, "BENCH_TPU_HEADLINE.json"), "w") as f:
                 f.write(line + "\n")
-        ok &= r.returncode == 0 and '"tpu"' in line
+        ok &= on_tpu
     except subprocess.TimeoutExpired:
         log("bench.py timed out (2400s)")
         ok = False
@@ -81,22 +84,25 @@ def run_benches() -> bool:
         r = subprocess.run([sys.executable, "bench_matrix.py"],
                            capture_output=True, text=True, timeout=5400,
                            cwd=REPO, env=env)
-        rows = [ln for ln in r.stdout.strip().splitlines()
-                if ln.startswith("{")]
+        rows = []
+        for ln in r.stdout.strip().splitlines():
+            if not ln.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(ln))
+            except json.JSONDecodeError:
+                log(f"  matrix: unparseable row {ln[:120]!r}")
         log(f"bench_matrix.py rc={r.returncode}: {len(rows)} rows")
         for ln in (r.stderr or "").strip().splitlines():
             log(f"  matrix: {ln}")
-        if rows:
+        tpu_rows = [row for row in rows if row.get("backend") == "tpu"]
+        if tpu_rows:
             with open(os.path.join(REPO, "BENCH_TPU_MATRIX.jsonl"), "a") as f:
                 stamp = time.strftime("%Y-%m-%dT%H:%M:%S")
-                for ln in rows:
-                    row = json.loads(ln)
+                for row in tpu_rows:
                     row["captured_at"] = stamp
                     f.write(json.dumps(row) + "\n")
-        ok &= r.returncode == 0 and any('"backend": "tpu"' in ln or
-                                        "'backend': 'tpu'" in ln or
-                                        json.loads(ln).get("backend") == "tpu"
-                                        for ln in rows)
+        ok &= r.returncode == 0 and bool(tpu_rows)
     except subprocess.TimeoutExpired:
         log("bench_matrix.py timed out (5400s)")
         ok = False
