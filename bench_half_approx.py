@@ -33,6 +33,10 @@ def main():
     ap.add_argument("--support", type=int, default=10)
     ap.add_argument("--mesh", type=int, default=4)
     ap.add_argument("--seed", type=int, default=77)
+    ap.add_argument("--threshold", type=int, default=None,
+                    help="per-dep explicit budget (default: derived from the "
+                         "sharded path's measured per-device bytes; small "
+                         "values force the spill + round-2 machinery)")
     args = ap.parse_args()
 
     # 8 fake CPU devices; must be in XLA_FLAGS before the backend initializes.
@@ -89,8 +93,11 @@ def main():
     # --- A: single-device half-approximate at ~equal memory.
     # Budget: explicit pairs + count-min table together should match B's
     # per-device pair bytes.  Explicit entry = 16 B, count-min counter = 4 B.
-    sbf_width = max(1 << 12, bytes_b // 8 // 4)  # half the budget to the sketch
-    threshold = max(4, (bytes_b // 2) // 16 // 64)  # per-dep explicit budget
+    from rdfind_tpu.ops import segments
+    sbf_width = max(1 << 12, segments.pow2_capacity(
+        bytes_b // 8 // 4))  # half the budget to the sketch (pow2 required)
+    threshold = (args.threshold if args.threshold is not None
+                 else max(4, (bytes_b // 2) // 16 // 64))  # per-dep budget
     sa: dict = {}
     small_to_large.discover(triples, args.support, explicit_threshold=threshold,
                             sbf_bits=8, sbf_width=sbf_width, stats=sa)

@@ -1370,3 +1370,125 @@ def discover_sharded_s2l(triples, min_support: int, mesh=None,
     return small_to_large._run_lattice(
         backend.cooc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
         min_support, use_ars, rules, clean_implied, stats, mesh=pipe.mesh)
+
+
+@functools.lru_cache(maxsize=None)
+def _stage_count_fcs(mesh, capacity: int, include_binary: bool):
+    """Compiled shard_map program: global distinct frequent-condition counts.
+
+    The distributed --find-only-fcs report over preshard arrays
+    (RDFind.scala:298-306 counted cluster-wide): per field group, distinct
+    keys travel to their hash owner, which counts its frequent ones; psum
+    totals them.
+    """
+    def f(triples, n_valid, min_support):
+        t_loc = triples.shape[0]
+        valid = jnp.arange(t_loc, dtype=jnp.int32) < n_valid[0]
+        groups = [(fld,) for fld in range(3)]
+        if include_binary:
+            groups += [(0, 1), (0, 2), (1, 2)]
+        counts = []
+        ovf_total = jnp.int32(0)
+        for i, fields in enumerate(groups):
+            cols = [triples[:, fld] for fld in fields]
+            n_u, ovf = exchange.global_distinct_frequent(
+                cols, valid, min_support, AXIS, capacity, seed=101 + i)
+            counts.append(n_u)
+            ovf_total += ovf
+        return jnp.stack(counts), ovf_total
+
+    return jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS), P()),
+        out_specs=(P(), P())))
+
+
+def count_fcs_sharded(g_triples, g_valid, min_support: int, mesh,
+                      include_binary: bool, max_retries: int = 4):
+    """(n_unary, n_binary|None) distinct frequent conditions over a preshard.
+
+    Capacity follows the plan/retry contract (expected per-(src, dst) volume
+    t_loc / D, doubled on overflow) — a worst-case pow2(t_loc) plan would put
+    full-table-sized route buffers on every device.
+    """
+    num_dev = mesh.devices.size
+    t_loc = g_triples.shape[0] // num_dev
+    capacity = _headroom(-(-t_loc // num_dev))
+    for _ in range(max_retries):
+        prog = _stage_count_fcs(mesh, capacity, include_binary)
+        counts, ovf = prog(g_triples, g_valid,
+                           jnp.int32(max(int(min_support), 1)))
+        ovf = int(np.asarray(host_gather(ovf)).reshape(-1)[0])
+        if ovf == 0:
+            break
+        capacity = segments.pow2_capacity(2 * capacity + ovf)
+        _check_exchange_caps(num_dev, fcs=capacity)
+    else:
+        raise RuntimeError(
+            f"frequent-condition exchange overflow persisted after "
+            f"{max_retries} retries (ovf={ovf})")
+    counts = np.asarray(host_gather(counts)).reshape(-1)[:6 if include_binary
+                                                        else 3]
+    n_unary = int(counts[:3].sum())
+    n_binary = int(counts[3:].sum()) if include_binary else None
+    return n_unary, n_binary
+
+
+@functools.lru_cache(maxsize=None)
+def _stage_dedupe_preshard(mesh, capacity: int):
+    """Compiled shard_map program: global row dedup of a preshard.
+
+    The distributed --distinct-triples pass (the reference's
+    triples.distinct): rows travel to their hash owner, the owner keeps one
+    copy of each, and the deduped rows stay owner-resident (any placement is
+    valid — exchange A re-routes every row by join value anyway).
+    """
+    def f(triples, n_valid):
+        t_loc = triples.shape[0]
+        valid = jnp.arange(t_loc, dtype=jnp.int32) < n_valid[0]
+        cols = [triples[:, i] for i in range(3)]
+        d = jax.lax.psum(1, AXIS)
+        bucket = hashing.bucket_of(cols, d, seed=31)
+        recv, recv_valid, ovf, _ = exchange.route(cols, valid, bucket, AXIS,
+                                                  capacity)
+        u_cols, u_valid, _, n_u = segments.masked_unique(recv, recv_valid)
+        out = jnp.stack(u_cols[:3], axis=1)[:t_loc]
+        return out, n_u.reshape(1), ovf
+
+    return jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS)),
+        out_specs=(P(AXIS, None), P(AXIS), P())))
+
+
+def dedupe_preshard(g_triples, g_valid, mesh, max_retries: int = 4):
+    """Global distinct rows over a preshard; returns (g_triples, g_valid, total).
+
+    Capacity follows the plan/retry contract: expected per-(src, dst) volume
+    is t_loc / D (hash spreads rows evenly), overflow doubles and retries —
+    a worst-case capacity of t_loc would put a full-table-sized receive
+    buffer on every device, which is exactly what sharding must avoid.
+    """
+    num_dev = mesh.devices.size
+    t_loc = g_triples.shape[0] // num_dev
+    capacity = _headroom(-(-t_loc // num_dev))
+    for _ in range(max_retries):
+        prog = _stage_dedupe_preshard(mesh, capacity)
+        out, n_valid, ovf = prog(g_triples, g_valid)
+        ovf = int(np.asarray(host_gather(ovf)).reshape(-1)[0])
+        if ovf == 0:
+            break
+        capacity = segments.pow2_capacity(2 * capacity + ovf)
+        _check_exchange_caps(num_dev, distinct=capacity)
+    else:
+        raise RuntimeError(
+            f"distinct-triples exchange overflow persisted after "
+            f"{max_retries} retries (ovf={ovf})")
+    n_valid_h = np.asarray(host_gather(n_valid)).reshape(-1)
+    if (n_valid_h > t_loc).any():
+        # A skewed hash can land more than t_loc DISTINCT rows on one owner;
+        # the [:t_loc] block slice must never silently truncate them.
+        raise RuntimeError(
+            f"distinct-triples owner block overflow (max owner rows="
+            f"{int(n_valid_h.max())} > t_loc={t_loc}); rerun with more "
+            f"devices")
+    total = int(n_valid_h.sum())
+    return out, n_valid, total

@@ -142,6 +142,31 @@ def global_row_counts(key_cols, valid, axis_name: str, capacity: int, *,
     return jnp.where(valid, ans_per_distinct[inv_safe], 0), overflow
 
 
+def global_distinct_frequent(key_cols, valid, min_support, axis_name: str,
+                             capacity: int, *, seed: int):
+    """GLOBAL number of distinct keys occurring >= min_support times.
+
+    The distributed form of the --find-only-fcs report (the reference counts
+    its frequent-condition Bloom filters cluster-wide): local distinct keys
+    carry local multiplicities to their hash owner, the owner sums and counts
+    its frequent keys, and a psum totals the owners.  Returns (count,
+    overflow); overflow > 0 invalidates the count (grow `capacity`).
+    """
+    d = jax.lax.psum(1, axis_name)
+    u_cols, u_valid, inv, _ = segments.masked_unique(key_cols, valid)
+    m = u_cols[0].shape[0]
+    inv_safe = jnp.clip(inv, 0, m - 1)
+    local_mult = jax.ops.segment_sum(valid.astype(jnp.int32), inv_safe,
+                                     num_segments=m)
+    bucket = hashing.bucket_of(u_cols, d, seed=seed)
+    recv, recv_valid, overflow, _ = route(u_cols + [local_mult], u_valid,
+                                          bucket, axis_name, capacity)
+    g = segments.masked_weighted_row_counts(recv[:-1], recv[-1], recv_valid)
+    ok = recv_valid & (g >= min_support)
+    _, _, _, n_u = segments.masked_unique(recv[:-1], ok)
+    return jax.lax.psum(n_u, axis_name), overflow
+
+
 def sorted_join_counts(table_cols, table_counts, table_valid, query_cols, query_valid):
     """For each query row, the count of its key in a distinct-key table (0 if absent).
 

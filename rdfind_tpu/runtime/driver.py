@@ -93,6 +93,18 @@ class _Phases:
         return out
 
 
+def _load_prefix_trie(cfg: Config):
+    """(trie, url_of) from the --prefixes files (shared by the replicated
+    shorten-urls phase and the sharded-ingest per-host transform)."""
+    ppaths = reader.resolve_path_patterns(cfg.prefix_paths)
+    pairs = []
+    for _, line in reader.iter_lines(ppaths):
+        p = prefixes.parse_prefix_line(line)
+        if p is not None:
+            pairs.append(p)
+    return prefixes.build_prefix_trie(pairs), dict(pairs)
+
+
 def _resolve_inputs(cfg: Config):
     """Input paths + quad-format sniff (shared by the native and Python paths)."""
     paths = reader.resolve_path_patterns(cfg.input_paths, cfg.file_filter)
@@ -122,14 +134,7 @@ def load_triples(cfg: Config, phases: _Phases, counters: dict):
 
     if cfg.prefix_paths:
         def shorten():
-            ppaths = reader.resolve_path_patterns(cfg.prefix_paths)
-            pairs = []
-            for _, line in reader.iter_lines(ppaths):
-                p = prefixes.parse_prefix_line(line)
-                if p is not None:
-                    pairs.append(p)
-            trie = prefixes.build_prefix_trie(pairs)
-            url_of = dict(pairs)
+            trie, url_of = _load_prefix_trie(cfg)
             return [tuple(prefixes.shorten_term(v, trie, url_of) for v in t)
                     for t in triples]
 
@@ -250,6 +255,12 @@ def describe_plan(cfg: Config) -> dict:
                 else "hash-partitioned interning")
         pre = [f"sharded-ingest (per-host parse+intern, {mode}, "
                "per-device row donation)"]
+        pre = ([ "asciify (per-host, during parse)"] if cfg.asciify_triples
+               else []) + \
+              (["shorten-urls (per-host, during parse)"] if cfg.prefix_paths
+               else []) + pre
+        if cfg.distinct_triples:
+            pre.append("distinct (hash-owner row dedup)")
     else:
         pre = ["read+parse"]
         if cfg.asciify_triples:
@@ -323,13 +334,9 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
     its file subset; no host materializes the full triple table)."""
     unsupported = [
         (cfg.checkpoint_dir is not None, "--checkpoint-dir"),
-        (cfg.asciify_triples, "--asciify-triples"),
-        (bool(cfg.prefix_paths), "--prefixes"),
-        (cfg.distinct_triples, "--distinct-triples"),
         (cfg.only_read or cfg.only_join, "--only-read/--do-only-join"),
         (cfg.use_association_rules, "--use-ars"),
         (cfg.ar_output_file is not None, "--ar-output"),
-        (cfg.find_only_fcs > 0, "--find-only-fcs"),
         (cfg.create_join_histogram, "--create-join-histogram"),
     ]
     bad = [name for cond, name in unsupported if cond]
@@ -343,17 +350,56 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
     paths, is_nq = _resolve_inputs(cfg)
     mesh = make_mesh(cfg.n_devices if cfg.n_devices > 1 else None)
 
+    # Token-local preprocessing (asciify, URL shortening) runs on each host's
+    # own shard during parse — same order as the replicated path's phases.
+    transform = None
+    if cfg.asciify_triples or cfg.prefix_paths:
+        steps = []
+        if cfg.asciify_triples:
+            steps.append(prefixes.asciify)
+        if cfg.prefix_paths:
+            trie, url_of = _load_prefix_trie(cfg)
+            steps.append(lambda v: prefixes.shorten_term(v, trie, url_of))
+
+        def transform(v, _steps=tuple(steps)):
+            for f in _steps:
+                v = f(v)
+            return v
+
     def ingest():
         return multihost_ingest.sharded_ingest(
             paths, mesh, tabs=cfg.tabs, expect_quad=is_nq,
             encoding=cfg.encoding, use_native=cfg.native_ingest,
             partition_dictionary={"auto": None, "partitioned": True,
-                                  "replicated": False}[cfg.interning])
+                                  "replicated": False}[cfg.interning],
+            transform=transform)
 
     g_triples, g_valid, dictionary, total = phases.run("sharded-ingest",
                                                        ingest)
     counters["input-triples"] = total
     counters["distinct-values"] = len(dictionary)
+
+    if cfg.distinct_triples:
+        def dedupe():
+            out = sharded.dedupe_preshard(g_triples, g_valid, mesh)
+            counters["distinct-triples"] = out[2]
+            return out[:2]
+        g_triples, g_valid = phases.run("distinct", dedupe)
+
+    if cfg.find_only_fcs >= 1:
+        # Distributed frequent-condition report over the preshard (level
+        # semantics as in the replicated path: >= 1 unary, >= 2 adds binary).
+        def mine_fcs():
+            n_unary, n_binary = sharded.count_fcs_sharded(
+                g_triples, g_valid, cfg.min_support, mesh,
+                include_binary=cfg.find_only_fcs >= 2)
+            counters["frequent-single-conditions"] = n_unary
+            if n_binary is not None:
+                counters["frequent-double-conditions"] = n_binary
+        phases.run("frequent-conditions", mine_fcs)
+        _report(cfg, counters, phases.timings)
+        return RunResult(CindTable.empty(), dictionary, None, counters,
+                         phases.timings)
 
     stats: dict = {}
     skew = _skew_from_cfg(cfg)

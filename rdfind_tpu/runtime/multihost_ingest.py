@@ -34,11 +34,17 @@ def shard_paths(paths: list[str], num_hosts: int, host_index: int) -> list[str]:
 
 
 def _local_ingest(paths, tabs: bool, expect_quad: bool, encoding,
-                  use_native: bool = True):
-    """This host's file subset -> (local (N,3) int32 ids, local Dictionary)."""
+                  use_native: bool = True, transform=None):
+    """This host's file subset -> (local (N,3) int32 ids, local Dictionary).
+
+    `transform(token) -> token` applies per-token string preprocessing
+    (asciify, URL shortening) before interning — token-local, so each host
+    runs it independently on its own shard; it forces the Python parse path.
+    """
     if not paths:
         return np.zeros((0, 3), np.int32), Dictionary(np.zeros(0, object))
-    if use_native and native.available() and reader.is_utf8(encoding):
+    if transform is None and use_native and native.available() \
+            and reader.is_utf8(encoding):
         return native.ingest_files(paths, tabs=tabs, expect_quad=expect_quad)
     from ..dictionary import intern_triples
 
@@ -47,7 +53,8 @@ def _local_ingest(paths, tabs: bool, expect_quad: bool, encoding,
         t = (ntriples.parse_tab_line(line) if tabs
              else ntriples.parse_line(line, expect_quad=expect_quad))
         if t is not None:
-            rows.append(t)
+            rows.append(t if transform is None else tuple(
+                transform(v) for v in t))
     if not rows:
         return np.zeros((0, 3), np.int32), Dictionary(np.zeros(0, object))
     return intern_triples(np.asarray(rows, dtype=object))
@@ -245,7 +252,8 @@ def partitioned_intern(local_values, num_hosts: int, host_index: int):
 def sharded_ingest(paths: list[str], mesh, *, tabs: bool = False,
                    expect_quad: bool = False, encoding="utf-8",
                    use_native: bool = True,
-                   partition_dictionary: bool | None = None):
+                   partition_dictionary: bool | None = None,
+                   transform=None):
     """Multi-host ingest over `mesh`.
 
     Returns (global_triples, global_n_valid, dictionary, total_triples):
@@ -268,7 +276,8 @@ def sharded_ingest(paths: list[str], mesh, *, tabs: bool = False,
     host_index = jax.process_index()
     my_paths = shard_paths(paths, num_hosts, host_index)
     local_ids, local_dict = _local_ingest(my_paths, tabs, expect_quad,
-                                          encoding, use_native)
+                                          encoding, use_native,
+                                          transform=transform)
 
     if partition_dictionary is None:
         partition_dictionary = num_hosts > 1

@@ -161,3 +161,49 @@ def test_two_process_sharded_ingest_s2l(tmp_path):
 @pytest.mark.parametrize("strategy", ["2", "3"])
 def test_two_process_sharded_ingest_approx_latebb(tmp_path, strategy):
     _check_ingest_strategy(tmp_path, strategy)
+
+
+def test_two_process_sharded_ingest_fcs_and_asciify(tmp_path):
+    """--find-only-fcs, --asciify-triples, and --distinct-triples run under
+    --sharded-ingest (distributed frequent-condition report, per-host token
+    transforms, hash-owner row dedup); counters must equal the replicated
+    single-process path's."""
+    paths = []
+    shards = list(NT_SHARDS)
+    shards[0] += "<zoé> <knows> <bob> .\n"  # asciify must normalize this
+    shards[1] += NT_SHARDS[0]  # cross-shard duplicates for --distinct-triples
+    for i, content in enumerate(shards):
+        p = tmp_path / f"shard{i}.nt"
+        p.write_text(content)
+        paths.append(str(p))
+
+    def counters_of(err):
+        return dict(l.strip().split(": ", 1) for l in err.splitlines()
+                    if l.strip().startswith(("frequent-", "distinct-triples")))
+
+    flags = ["--support", "2", "--find-only-fcs", "2", "--asciify-triples",
+             "--distinct-triples", "--counters", "1"]
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "rdfind_tpu.programs.rdfind", *paths, *flags,
+         "--sharded-ingest", "--coordinator", f"127.0.0.1:{port}",
+         "--num-hosts", "2", "--host-index", str(pid)],
+        cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=env) for pid in range(2)]
+    outs = [p.communicate(timeout=540) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+    got = counters_of(outs[0][1])
+
+    r = subprocess.run(
+        [sys.executable, "-m", "rdfind_tpu.programs.rdfind", *paths, *flags],
+        cwd=_REPO, capture_output=True, text=True, env=env, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    want = counters_of(r.stderr)
+    assert "frequent-single-conditions" in want
+    assert "distinct-triples" in want
+    assert got == want
