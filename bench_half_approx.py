@@ -94,8 +94,9 @@ def main():
     # Budget: explicit pairs + count-min table together should match B's
     # per-device pair bytes.  Explicit entry = 16 B, count-min counter = 4 B.
     from rdfind_tpu.ops import segments
-    sbf_width = max(1 << 12, segments.pow2_capacity(
-        bytes_b // 8 // 4))  # half the budget to the sketch (pow2 required)
+    # Half the budget to the sketch: bytes_b/2 bytes at 4 B/counter (pow2
+    # counter count required by the hash mixer).
+    sbf_width = max(1 << 12, segments.pow2_capacity(bytes_b // 2 // 4))
     threshold = (args.threshold if args.threshold is not None
                  else max(4, (bytes_b // 2) // 16 // 64))  # per-dep budget
     sa: dict = {}

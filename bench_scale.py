@@ -42,7 +42,7 @@ def write_shards(n: int, seed: int, out_dir: str, n_shards: int = 8):
     sys.path.insert(0, REPO)
     import numpy as np
 
-    from rdfind_tpu.utils.synth import generate_triples
+    from rdfind_tpu.utils.synth import generate_dbpedia_shaped
 
     os.makedirs(out_dir, exist_ok=True)
     paths = [os.path.join(out_dir, f"shard{i}.tsv") for i in range(n_shards)]
@@ -52,8 +52,8 @@ def write_shards(n: int, seed: int, out_dir: str, n_shards: int = 8):
     while done < n:
         m = min(chunk, n - done)
         # Independent chunks with distinct seeds: the union keeps the same
-        # power-law shape while generation stays O(chunk) RAM.
-        t = generate_triples(m, seed=seed + done // chunk)
+        # DBpedia-like shape while generation stays O(chunk) RAM.
+        t = generate_dbpedia_shaped(m, seed=seed + done // chunk)
         shard_of = (np.arange(m) + done) % n_shards
         for i, f in enumerate(files):
             rows = t[shard_of == i]
@@ -70,8 +70,8 @@ def write_shards(n: int, seed: int, out_dir: str, n_shards: int = 8):
 def run_two_hosts(paths, support: int, strategy: int, extra=(),
                   timeout_s: int = 4 * 3600):
     port = _free_port()
-    outs = []
     procs = []
+    logs = []
     for pid in range(2):
         cmd = [sys.executable, "-m", "rdfind_tpu.programs.rdfind",
                *paths, "--tabs", "--support", str(support),
@@ -86,20 +86,34 @@ def run_two_hosts(paths, support: int, strategy: int, extra=(),
             " --xla_cpu_collective_timeout_seconds=7200"
             " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
             " --xla_cpu_collective_call_terminate_timeout_seconds=7200")
+        # Worker output goes to FILES, not pipes: with pipes drained
+        # sequentially, a worker that fills its 64 KB stderr pipe (XLA
+        # warnings) blocks mid-collective and deadlocks the pair.
+        out_f = open(f"/tmp/bench_scale_w{pid}_s{strategy}.out", "w")
+        err_f = open(f"/tmp/bench_scale_w{pid}_s{strategy}.err", "w")
+        logs.append((out_f, err_f))
         procs.append(subprocess.Popen(
-            cmd, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True, env=env))
+            cmd, cwd=REPO, stdout=out_f, stderr=err_f, text=True, env=env))
     try:
         for p in procs:
-            outs.append(p.communicate(timeout=timeout_s))
+            p.wait(timeout=timeout_s)
     finally:
         # Never orphan multi-GB workers (a killed parent must not leave two
         # coordinated processes thrashing the box's one core).
         for p in procs:
             if p.poll() is None:
                 p.kill()
-        while len(outs) < len(procs):
-            outs.append(procs[len(outs)].communicate())
+                p.wait()
+        for out_f, err_f in logs:
+            out_f.close()
+            err_f.close()
+    outs = []
+    for pid in range(2):
+        with open(f"/tmp/bench_scale_w{pid}_s{strategy}.out") as f:
+            out = f.read()
+        with open(f"/tmp/bench_scale_w{pid}_s{strategy}.err") as f:
+            err = f.read()
+        outs.append((out, err))
     return procs, outs
 
 

@@ -829,8 +829,13 @@ def discover(triples, min_support: int, projections: str = "spo",
     per-dependent counters in round 1, tail in a count-min sketch with
     `sbf_bits` per counter (--sbf-bytes; default sized to hold min_support)
     and `sbf_width` counters, exact round 2 only for inexact dependents.
-    Output is identical to the exact path; it implies the chunked backend
-    (the dense backend holds the whole cooc matrix anyway).
+    Output is identical to the exact path; it implies the chunked backend.
+    That is by design, not a gap: the knob exists to bound MATERIALIZED PAIR
+    memory, and the dense backend materializes no pairs at all (one bitpacked
+    M^T M matmul whose footprint is the fixed l_pad x c_pad membership matrix)
+    — on the dense path the bound it provides is already met by construction,
+    so forcing chunked preserves the reference's "this flag selects the
+    two-round algorithm" semantics instead of silently no-op'ing.
 
     balanced_11 (--balanced-overlap-candidates) halves the chunked backend's
     materialized 1/1 emission via rotation ownership (each unordered pair

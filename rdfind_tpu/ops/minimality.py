@@ -238,7 +238,16 @@ def minimize_table_sharded(table: CindTable, mesh) -> CindTable:
         max(64, (6 * blk) // num_dev + (6 * blk) // (num_dev * 4)))
     from ..parallel.mesh import host_gather, make_global
 
-    while True:
+    max_retries = 4
+    for _ in range(max_retries):
+        if num_dev * capacity > (1 << 31) - 1:
+            # route()'s (D * capacity) flat index is int32; wrapping it would
+            # silently corrupt the keep mask, so fail the way every other
+            # planned exchange does.
+            raise RuntimeError(
+                f"minimality exchange capacity {capacity} x {num_dev} "
+                f"devices exceeds the int32 buffer budget; rerun with more "
+                f"devices")
         prog = _stage_keep_sharded(mesh, capacity)
         # make_global: each process donates only the rows its devices own
         # (device_put of a host array is single-process-only).
@@ -249,5 +258,9 @@ def minimize_table_sharded(table: CindTable, mesh) -> CindTable:
         if ovf == 0:
             break
         capacity = segments.pow2_capacity(2 * capacity + ovf)
+    else:
+        raise RuntimeError(
+            f"minimality exchange overflow persisted after {max_retries} "
+            f"retries (ovf={ovf})")
     keep = np.asarray(host_gather(keep)).reshape(-1)[:n]
     return _apply_keep(table, keep)

@@ -28,11 +28,19 @@ _MULTIHOST_INITIALIZED = False
 
 
 def initialize_multihost(coordinator: str, num_processes: int,
-                         process_id: int) -> None:
+                         process_id: int, *,
+                         shutdown_timeout_seconds: int = 7200) -> None:
     """Join this process to a multi-host run (idempotent per process).
 
     `coordinator` is `host:port` of process 0.  Must be called before any
     other jax API touches the backend.
+
+    shutdown_timeout_seconds raises jax's default 300 s exit barrier: hosts
+    finish batch phases minutes apart when compute is uneven (or, on the CPU
+    minicluster, when one core timeshares every "device"), and a host that
+    exits first must wait at the barrier instead of tearing the runtime down
+    under its peers (observed: an 8M-triple 2-process run lost host 0 to the
+    default barrier while it was still in its final phase).
     """
     global _MULTIHOST_INITIALIZED
     # NB: probing via jax.process_count() would itself initialize the XLA
@@ -41,7 +49,8 @@ def initialize_multihost(coordinator: str, num_processes: int,
         return  # already joined (jax.distributed.initialize is once-only)
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
-                               process_id=process_id)
+                               process_id=process_id,
+                               shutdown_timeout_seconds=shutdown_timeout_seconds)
     _MULTIHOST_INITIALIZED = True
 
 

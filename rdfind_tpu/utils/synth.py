@@ -79,3 +79,46 @@ def inject_cind_structure(triples: np.ndarray, n_rules: int = 32,
         base = int(max(obj_a.max(), pred_b)) + 1
     overlay = np.concatenate(rows).astype(np.int32)
     return np.concatenate([np.asarray(triples, np.int32), overlay])
+
+
+def generate_dbpedia_shaped(n: int, seed: int = 0) -> np.ndarray:
+    """(n, 3) int32 triples with DBpedia-like cardinalities for SCALE runs.
+
+    The plain generator's zipf-1.3 single-field hubs concentrate ~10% of all
+    rows on one subject — far beyond real DBpedia, where a subject averages
+    tens of triples and even hub entities stay in the thousands.  This shape
+    spreads each zipf rank's mass over ``n_vals / cap`` block ids, which
+    caps hub degree at roughly ``P(rank 1) * cap / density`` — measured
+    ~12k rows for the hottest subject and ~5k for the hottest literal,
+    CONSTANT in n (both pools and block counts scale with n).  ~1.2k
+    predicates keep a true rdf:type-like hub (~23% of rows); objects are 60%
+    light-tailed literals / 40% subject-pool URIs.  The quadratic pair phase
+    then scales the way the reference's target data does: frequent-value
+    populations grow slowly, not with the hottest id.
+    """
+    rng = np.random.default_rng(seed)
+    n_subj = max(64, n // 12)
+    n_pred = 1200
+    n_lit = max(64, n // 6)
+
+    def bounded_zipf(a, size, n_vals, cap):
+        v = rng.zipf(a, size=size)
+        return ((v - 1) % min(n_vals, cap) + rng.integers(
+            0, max(n_vals // max(cap, 1), 1), size) * cap) % n_vals
+
+    subj = bounded_zipf(1.7, n, n_subj, 2048).astype(np.int32)
+    ranks = np.arange(1, n_pred + 1, dtype=np.float64)
+    p_pred = (1.0 / ranks ** 1.2)
+    p_pred /= p_pred.sum()
+    pred = rng.choice(n_pred, size=n, p=p_pred).astype(np.int32)
+    is_uri = rng.random(n) < 0.4
+    obj_uri = bounded_zipf(1.7, n, n_subj, 2048).astype(np.int32)
+    # Literals: big pool, light tail (DBpedia literals rarely repeat past a
+    # few hundred) — the frequent-object population is what the quadratic
+    # pair phase squares over, so its size must track the real profile.
+    obj_lit = bounded_zipf(2.1, n, n_lit, 1024).astype(np.int32)
+
+    subj_ids = subj
+    pred_ids = n_subj + pred
+    obj_ids = np.where(is_uri, obj_uri, n_subj + n_pred + obj_lit)
+    return np.stack([subj_ids, pred_ids, obj_ids.astype(np.int32)], axis=1)

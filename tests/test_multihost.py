@@ -10,6 +10,7 @@ import os
 import socket
 import subprocess
 import sys
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 import pytest
@@ -32,7 +33,8 @@ def _run_workers(strategy: str):
          str(pid), "2", str(port), strategy],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
         for pid in range(2)]
-    outs = [p.communicate(timeout=540) for p in procs]
+    with ThreadPoolExecutor(len(procs)) as ex:
+        outs = list(ex.map(lambda p: p.communicate(timeout=540), procs))
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
     rows_line = [l for l in outs[0][0].splitlines() if l.startswith("ROWS ")]
@@ -83,7 +85,8 @@ def _run_ingest_workers(paths, mode: str, strategy: str = "0"):
          str(pid), "2", str(port), ",".join(paths), mode, strategy],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
         for pid in range(2)]
-    outs = [p.communicate(timeout=540) for p in procs]
+    with ThreadPoolExecutor(len(procs)) as ex:
+        outs = list(ex.map(lambda p: p.communicate(timeout=540), procs))
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
     lines = dict(l.split(" ", 1) for l in outs[0][0].splitlines()
@@ -163,6 +166,19 @@ def test_two_process_sharded_ingest_approx_latebb(tmp_path, strategy):
     _check_ingest_strategy(tmp_path, strategy)
 
 
+def test_two_process_sharded_ingest_empty_shard(tmp_path):
+    """One input file, two hosts: host 1 owns ZERO files, so its local
+    dictionary is empty in every interning round — the partitioned-interning
+    collectives and row donation must handle the empty shard."""
+    p = tmp_path / "only.nt"
+    p.write_text("".join(NT_SHARDS))
+    lines, dicts = _run_ingest_workers([str(p)], "partitioned")
+    ids, n_distinct, want = _ingest_golden([str(p)])
+    assert int(lines["TOTAL"]) == ids.shape[0]
+    assert json.loads(lines["CINDS"]) == want
+    assert sum(d["own"] for d in dicts) == n_distinct
+
+
 def test_two_process_sharded_ingest_fcs_and_asciify(tmp_path):
     """--find-only-fcs, --asciify-triples, and --distinct-triples run under
     --sharded-ingest (distributed frequent-condition report, per-host token
@@ -194,7 +210,8 @@ def test_two_process_sharded_ingest_fcs_and_asciify(tmp_path):
          "--num-hosts", "2", "--host-index", str(pid)],
         cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True, env=env) for pid in range(2)]
-    outs = [p.communicate(timeout=540) for p in procs]
+    with ThreadPoolExecutor(len(procs)) as ex:
+        outs = list(ex.map(lambda p: p.communicate(timeout=540), procs))
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
     got = counters_of(outs[0][1])
