@@ -69,6 +69,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -626,21 +627,27 @@ def _shard_triples(triples, num_dev, t_loc: int | None = None):
     return padded, n_valid, t_loc
 
 
-# Largest total exchange buffer (rows) an int32-indexed (D * capacity) scatter
-# can address; beyond it the plan must fail loudly, not wrap (a 60k-triple
-# support-5 smoke found route()'s flat index overflowing instead).
+# Largest TOTAL buffer (rows) an int32-indexed scatter/sort can address;
+# beyond it the plan must fail loudly, not wrap (a 60k-triple support-5 smoke
+# found route()'s flat index overflowing instead).  Exchange/all_gather
+# buffers total D * capacity rows per device; local pair buffers total their
+# capacity.
 MAX_EXCHANGE_ROWS = (1 << 31) - 1
+
+
+def _check_caps(**total_rows) -> None:
+    """Every named buffer's TOTAL rows must stay int32-indexable."""
+    for name, rows in total_rows.items():
+        if int(rows) > MAX_EXCHANGE_ROWS:
+            raise RuntimeError(
+                f"planned buffer {name}={int(rows)} rows exceeds the int32 "
+                f"indexing budget; this workload's pair volume needs more "
+                f"devices, a higher --support, or --use-fis pruning")
 
 
 def _check_exchange_caps(num_dev: int, **caps) -> None:
     """Planned capacities must keep every (D * capacity) buffer int32-indexable."""
-    for name, c in caps.items():
-        if num_dev * int(c) > MAX_EXCHANGE_ROWS:
-            raise RuntimeError(
-                f"planned exchange capacity {name}={c} x {num_dev} devices "
-                f"exceeds the int32 buffer budget; this workload's pair "
-                f"volume needs more devices, a higher --support, or "
-                f"--use-fis pruning")
+    _check_caps(**{name: num_dev * int(c) for name, c in caps.items()})
 
 
 def _headroom(measured: int, floor: int = CAP_FLOOR) -> int:
@@ -709,14 +716,20 @@ class _Pipeline:
         self.lines = line_cols  # jv, code, v1, v2 — device-resident
         self.n_rows = n_rows
         plan = host_gather(plan).reshape(self.num_dev, 4)[0]
+        if os.environ.get("RDFIND_DEBUG_PLAN"):
+            print(f"debug plan (per-device maxima): lines_b={int(plan[0])} "
+                  f"pairs={int(plan[1])} giant_rows={int(plan[2])} "
+                  f"giant_pairs={int(plan[3])}", file=sys.stderr, flush=True)
         self.cap_b = _headroom(plan[0])
         self.cap_p = _headroom(plan[1], floor=1 << 10)
         self.cap_g = _headroom(plan[2])
         self.cap_gp = _headroom(2 * int(plan[3]), floor=1 << 10)
-        self.cap_c = segments.pow2_capacity(self.cap_p + self.cap_gp)
-        _check_exchange_caps(self.num_dev, exchange_b=self.cap_b,
-                             pairs=self.cap_p, giant_rows=self.cap_g,
-                             giant_pairs=self.cap_gp, exchange_c=self.cap_c)
+        # Exchange C per-(src, dst) capacity: the deduped pair partials are
+        # hash-spread over dep-capture owners, so the expected per-destination
+        # share is (pairs + giant pairs) / D; overflow retries cover skew.
+        self.cap_c = _headroom((self.cap_p + self.cap_gp)
+                               // max(self.num_dev, 1), floor=1 << 10)
+        self._check_pair_caps()
 
         # P2b: load-aware placement of the measured hot tail.
         self._maybe_rebalance()
@@ -730,6 +743,7 @@ class _Pipeline:
             if ovf_b == 0:
                 break
             self.cap_b = segments.pow2_capacity(2 * self.cap_b + ovf_b)
+            _check_caps(exchange_b=self.num_dev * self.cap_b)
         else:
             raise RuntimeError(
                 f"capture-count overflow persisted after {max_retries} retries "
@@ -828,9 +842,15 @@ class _Pipeline:
             self.cap_g = segments.pow2_capacity(2 * self.cap_g + int(ovf[2]))
         if ovf[3] > 0:
             self.cap_gp = segments.pow2_capacity(2 * self.cap_gp + int(ovf[3]))
-        _check_exchange_caps(self.num_dev, pairs=self.cap_p,
-                             exchange_c=self.cap_c, giant_rows=self.cap_g,
-                             giant_pairs=self.cap_gp)
+        self._check_pair_caps()
+
+    def _check_pair_caps(self):
+        # Local emission buffers count their own rows; exchanges B/C and the
+        # giant-line all_gather count D x capacity.
+        _check_caps(pair_stream=self.cap_p + self.cap_gp,
+                    exchange_b=self.num_dev * self.cap_b,
+                    exchange_c=self.num_dev * self.cap_c,
+                    giant_gather=self.num_dev * self.cap_g)
 
     def collect_blocks(self, cols, n_out):
         """Per-device compacted outputs -> host rows."""

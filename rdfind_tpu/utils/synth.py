@@ -84,41 +84,62 @@ def inject_cind_structure(triples: np.ndarray, n_rules: int = 32,
 def generate_dbpedia_shaped(n: int, seed: int = 0) -> np.ndarray:
     """(n, 3) int32 triples with DBpedia-like cardinalities for SCALE runs.
 
-    The plain generator's zipf-1.3 single-field hubs concentrate ~10% of all
-    rows on one subject — far beyond real DBpedia, where a subject averages
-    tens of triples and even hub entities stay in the thousands.  This shape
-    spreads each zipf rank's mass over ``n_vals / cap`` block ids, which
-    caps hub degree at roughly ``P(rank 1) * cap / density`` — measured
-    ~12k rows for the hottest subject and ~5k for the hottest literal,
-    CONSTANT in n (both pools and block counts scale with n).  ~1.2k
-    predicates keep a true rdf:type-like hub (~23% of rows); objects are 60%
-    light-tailed literals / 40% subject-pool URIs.  The quadratic pair phase
-    then scales the way the reference's target data does: frequent-value
-    populations grow slowly, not with the hottest id.
+    What the quadratic pair phase squares over is the number of FREQUENT
+    captures per join line, so the generator controls per-id degrees
+    directly: zipf draws are split into degree-capped clones (subjects and
+    URI objects cap at ~64 rows per generation call, literals at ~128 —
+    below a support-1000 threshold, like the long tail of real DBpedia),
+    plus ~200 enumeration-style hub literals (country/type names) whose
+    degree is ~n * 1.5e-4: they clear support 1000 once n >= ~7M and stay
+    infrequent below that (at the measured 2M scale point object conditions
+    are all infrequent, so its CINDs are predicate-level).  Predicates
+    follow a 1.2-exponent
+    zipf over ~1.2k ids with a true rdf:type-like hub.  Total line-pair
+    volume then scales like n * cap — the reference's target regime — not
+    with the hottest id.
     """
     rng = np.random.default_rng(seed)
     n_subj = max(64, n // 12)
     n_pred = 1200
     n_lit = max(64, n // 6)
+    n_hub_lit = 200
 
-    def bounded_zipf(a, size, n_vals, cap):
-        v = rng.zipf(a, size=size)
-        return ((v - 1) % min(n_vals, cap) + rng.integers(
-            0, max(n_vals // max(cap, 1), 1), size) * cap) % n_vals
+    def capped_zipf(a, size, n_vals, cap):
+        """Zipf-shaped draws with per-id degree capped at ~cap rows.
 
-    subj = bounded_zipf(1.7, n, n_subj, 2048).astype(np.int32)
+        The per-call random base keeps independently-seeded chunks from
+        stacking degrees on the same ids (rank 1 clone 0 must not map to one
+        global id across every chunk of a chunked generation — that would
+        grow hub degree as cap x n_chunks and void the cap).
+        """
+        v = rng.zipf(a, size=size).astype(np.int64)
+        order = np.argsort(v, kind="stable")
+        vs = v[order]
+        run_start = np.flatnonzero(np.r_[True, vs[1:] != vs[:-1]])
+        run_len = np.diff(np.append(run_start, len(vs)))
+        within = np.arange(len(vs)) - np.repeat(run_start, run_len)
+        clone = within // cap
+        base = rng.integers(0, n_vals)
+        ids = (vs * 1000003 + clone * 7919 + base) % n_vals
+        out = np.empty(size, np.int64)
+        out[order] = ids
+        return out.astype(np.int32)
+
+    subj = capped_zipf(1.7, n, n_subj, 64)
     ranks = np.arange(1, n_pred + 1, dtype=np.float64)
     p_pred = (1.0 / ranks ** 1.2)
     p_pred /= p_pred.sum()
     pred = rng.choice(n_pred, size=n, p=p_pred).astype(np.int32)
     is_uri = rng.random(n) < 0.4
-    obj_uri = bounded_zipf(1.7, n, n_subj, 2048).astype(np.int32)
-    # Literals: big pool, light tail (DBpedia literals rarely repeat past a
-    # few hundred) — the frequent-object population is what the quadratic
-    # pair phase squares over, so its size must track the real profile.
-    obj_lit = bounded_zipf(2.1, n, n_lit, 1024).astype(np.int32)
+    obj_uri = capped_zipf(1.7, n, n_subj, 64)
+    obj_lit = capped_zipf(2.1, n, n_lit, 128)
+    # Enumeration-style hub literals: uncapped, genuinely frequent objects.
+    is_hub = (~is_uri) & (rng.random(n) < 0.05)
+    obj_lit = np.where(is_hub, n_lit + rng.integers(0, n_hub_lit, n),
+                       obj_lit).astype(np.int32)
 
     subj_ids = subj
     pred_ids = n_subj + pred
-    obj_ids = np.where(is_uri, obj_uri, n_subj + n_pred + obj_lit)
+    obj_ids = np.where(is_uri, obj_uri,
+                       n_subj + n_pred + obj_lit)
     return np.stack([subj_ids, pred_ids, obj_ids.astype(np.int32)], axis=1)
