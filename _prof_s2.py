@@ -1,0 +1,23 @@
+import time, numpy as np
+import rdfind_tpu.models.approximate as ap
+import rdfind_tpu.models.allatonce as aa
+import rdfind_tpu.models.small_to_large as s2l
+from rdfind_tpu.utils.synth import generate_triples
+from rdfind_tpu.ops import sketch
+
+triples = generate_triples(100_000, seed=101, n_predicates=18, n_entities=17_000)
+
+for it in range(2):
+    stats = {}
+    t0=time.perf_counter()
+    st = ap.prepare_join_lines(triples, 10, "spo", True, False, stats)
+    t1=time.perf_counter(); print(it, "prepare", round(t1-t0,2), flush=True)
+    sk = ap._build_sketches(st["line_val_h"], st["line_cap_h"], st["num_caps"], bits=sketch.DEFAULT_BITS, num_hashes=sketch.DEFAULT_HASHES)
+    t2=time.perf_counter(); print(it, "sketches", round(t2-t1,2), flush=True)
+    frequent = st["dep_count"] >= 10
+    cd, cr = ap._candidate_pairs(sk, st["num_caps"], bits=sketch.DEFAULT_BITS, num_hashes=sketch.DEFAULT_HASHES, dep_mask=frequent, ref_mask=frequent)
+    t3=time.perf_counter(); print(it, "cand_pairs", round(t3-t2,2), "n_cand", len(cd), flush=True)
+    def cooc_fn(dep_ok, ref_ok, key):
+        return s2l._chunked_cooc(st["line_val_h"], st["line_cap_h"], dep_ok, ref_ok, aa.PAIR_CHUNK_BUDGET, stats, key)
+    d, r, sup = s2l._verify_level(cooc_fn, cd, cr, st["num_caps"], st["dep_count"], st["cap_code"], st["cap_v1"], st["cap_v2"], 10, "pairs_verify")
+    t4=time.perf_counter(); print(it, "verify", round(t4-t3,2), "cinds", len(d), flush=True)

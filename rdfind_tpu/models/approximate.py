@@ -30,10 +30,14 @@ raw output is identical to raw AllAtOnce (differential-tested).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..data import CindTable
+from ..ops import cooc as cooc_ops
 from ..ops import frequency, minimality, segments, sketch
 from . import allatonce, small_to_large
 
@@ -132,6 +136,85 @@ def _candidate_pairs(sketches, num_caps, *, bits, num_hashes,
     return np.concatenate(out_d), np.concatenate(out_r)
 
 
+@functools.partial(jax.jit, static_argnames=("tile",))
+def _stage_tile_counts(m, dep_lo, d_local, r_idx, valid, *, tile: int):
+    """Exact co-occurrence counts for candidate pairs inside one dep tile.
+
+    m: (l_pad, c_pad) bf16 membership matrix; one (tile x c_pad) MXU matmul
+    computes the tile's cooc block, then the candidate (dep, ref) positions are
+    gathered on device — only the per-pair counts travel back to the host.
+    """
+    m_tile = jax.lax.dynamic_slice(m, (0, dep_lo), (m.shape[0], tile))
+    cooc = jax.lax.dot_general(
+        m_tile, m, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.int32)
+    return jnp.where(valid, cooc[d_local, r_idx], 0)
+
+
+def _dense_verify_counts(line_val_h, line_cap_h, num_caps, cand_dep, cand_ref,
+                         dep_ok, ref_ok, stats, stat_key):
+    """Round-2 verification on the dense MXU path: exact cooc counts for the
+    candidate pairs, or None when the membership matrix exceeds the HBM budget
+    (caller falls back to the chunked loop).
+
+    Same row filter as the chunked backend (_iter_chunk_pairs): rows flagged
+    for neither side belong to captures in no candidate pair, so dropping them
+    cannot change any candidate's count.  Replaces the per-chunk host loop of
+    CreateApproximatedCindCandidates.scala:59-163 with one membership scatter
+    plus a tiled matmul sweep — the same stage AllAtOnce verifies with, here
+    restricted to the sketch survivors.
+    """
+    row_keep = dep_ok[line_cap_h] | ref_ok[line_cap_h]
+    lv, lc = line_val_h[row_keep], line_cap_h[row_keep]
+    n = lv.shape[0]
+    if n == 0:
+        return np.zeros(len(cand_dep), np.int64)
+    starts = np.empty(n, bool)
+    starts[0] = True
+    starts[1:] = lv[1:] != lv[:-1]
+    line_gid = np.cumsum(starts, dtype=np.int64) - 1
+    num_lines = int(line_gid[-1]) + 1
+    plan = cooc_ops.dense_plan(num_lines, num_caps)
+    if plan is None or plan[1] > allatonce.SINGLE_SHOT_C:
+        return None
+    l_pad, c_pad, tile = plan
+    if stats is not None:
+        lens = np.diff(np.append(np.flatnonzero(starts), n)).astype(np.int64)
+        tot = int((lens * (lens - 1)).sum())
+        stats[stat_key] = stats.get(stat_key, 0) + tot
+        stats["total_pairs"] = stats.get("total_pairs", 0) + tot
+        stats["pair_backend"] = "matmul"
+
+    row_cap = segments.pow2_capacity(n)
+    pad = allatonce._pad_np
+    m = cooc_ops.build_membership(
+        jnp.asarray(pad(line_gid.astype(np.int32), row_cap, l_pad)),
+        jnp.asarray(pad(lc.astype(np.int32), row_cap, c_pad)),
+        jnp.arange(row_cap, dtype=jnp.int32) < n, l_pad=l_pad, c_pad=c_pad)
+
+    # Candidates grouped by dep tile (defensive sort: _candidate_pairs emits
+    # dep-ascending, but the contract here is order-insensitive).
+    order = np.argsort(cand_dep, kind="stable")
+    d_sorted, r_sorted = cand_dep[order], cand_ref[order]
+    cnt_sorted = np.zeros(len(cand_dep), np.int64)
+    for lo in range(0, num_caps, tile):
+        a = np.searchsorted(d_sorted, lo)
+        b = np.searchsorted(d_sorted, lo + tile)
+        if a == b:
+            continue
+        k = b - a
+        k_cap = segments.pow2_capacity(k)
+        got = _stage_tile_counts(
+            m, jnp.int32(lo),
+            jnp.asarray(pad((d_sorted[a:b] - lo).astype(np.int32), k_cap, 0)),
+            jnp.asarray(pad(r_sorted[a:b].astype(np.int32), k_cap, 0)),
+            jnp.arange(k_cap, dtype=jnp.int32) < k, tile=tile)
+        cnt_sorted[a:b] = np.asarray(got)[:k]
+    cnt = np.empty_like(cnt_sorted)
+    cnt[order] = cnt_sorted
+    return cnt
+
+
 # Shared phase A lives with the staging code it drives.
 prepare_join_lines = allatonce.prepare_join_lines
 
@@ -143,8 +226,16 @@ def discover(triples, min_support: int, projections: str = "spo",
              pair_chunk_budget: int = allatonce.PAIR_CHUNK_BUDGET,
              sketch_bits: int = sketch.DEFAULT_BITS,
              sketch_hashes: int = sketch.DEFAULT_HASHES,
+             pair_backend: str = "auto",
              stats: dict | None = None) -> CindTable:
-    """Discover all CINDs; raw output equals allatonce.discover's raw output."""
+    """Discover all CINDs; raw output equals allatonce.discover's raw output.
+
+    pair_backend selects the round-2 verification: "matmul" gathers exact
+    counts from the dense membership matmul (requires the dense plan to fit),
+    "chunked" runs the legacy host chunk loop, "auto" (default) picks matmul
+    whenever the membership matrix fits the HBM budget.  Round 1 (the sketch
+    build and the candidate containment matmul) is backend-independent.
+    """
     min_support = max(int(min_support), 1)
     use_ars = use_association_rules and use_frequent_condition_filter
     st = prepare_join_lines(triples, min_support, projections,
@@ -166,14 +257,40 @@ def discover(triples, min_support: int, projections: str = "spo",
     if stats is not None:
         stats["n_sketch_candidates"] = len(cand_dep)
 
-    def cooc_fn(dep_ok, ref_ok, stat_key):
-        return small_to_large._chunked_cooc(
-            st["line_val_h"], st["line_cap_h"], dep_ok, ref_ok,
-            pair_chunk_budget, stats, stat_key)
+    if pair_backend not in ("auto", "matmul", "chunked"):
+        raise ValueError(f"unknown pair_backend {pair_backend!r}")
+    cnt = None
+    if pair_backend in ("auto", "matmul") and len(cand_dep):
+        dep_ok = np.zeros(st["num_caps"], bool)
+        dep_ok[cand_dep] = True
+        ref_ok = np.zeros(st["num_caps"], bool)
+        ref_ok[cand_ref] = True
+        cnt = _dense_verify_counts(
+            st["line_val_h"], st["line_cap_h"], st["num_caps"],
+            cand_dep, cand_ref, dep_ok, ref_ok, stats, "pairs_verify")
+        if cnt is None and pair_backend == "matmul":
+            raise ValueError("pair_backend='matmul' but the dense plan "
+                             "does not fit the single-shot budget")
 
-    d, r, sup = small_to_large._verify_level(
-        cooc_fn, cand_dep, cand_ref, st["num_caps"], st["dep_count"],
-        st["cap_code"], st["cap_v1"], st["cap_v2"], min_support, "pairs_verify")
+    if cnt is not None:
+        sup_all = st["dep_count"][cand_dep]
+        is_cind = (cnt == sup_all) & (sup_all >= min_support)
+        is_cind &= ~small_to_large._implied_mask(
+            cand_dep, cand_ref, st["cap_code"], st["cap_v1"], st["cap_v2"])
+        d, r, sup = cand_dep[is_cind], cand_ref[is_cind], sup_all[is_cind]
+    else:
+        if stats is not None:
+            stats["pair_backend"] = "chunked"
+
+        def cooc_fn(dep_ok, ref_ok, stat_key):
+            return small_to_large._chunked_cooc(
+                st["line_val_h"], st["line_cap_h"], dep_ok, ref_ok,
+                pair_chunk_budget, stats, stat_key)
+
+        d, r, sup = small_to_large._verify_level(
+            cooc_fn, cand_dep, cand_ref, st["num_caps"], st["dep_count"],
+            st["cap_code"], st["cap_v1"], st["cap_v2"], min_support,
+            "pairs_verify")
 
     cap_code, cap_v1, cap_v2 = st["cap_code"], st["cap_v1"], st["cap_v2"]
     table = CindTable(

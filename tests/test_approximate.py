@@ -85,6 +85,31 @@ def test_chunked_sketch_build_matches():
     np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.parametrize("seed,min_support", [(19, 2), (23, 1)])
+def test_dense_verify_matches_chunked(seed, min_support):
+    # Round-2 verification backends must agree pair-for-pair: the dense
+    # membership-matmul gather vs the legacy chunk loop, both vs AllAtOnce.
+    rng = random.Random(seed)
+    triples = random_triples(rng, 180, 14, 4, 9)
+    ids, _ = intern_triples(np.asarray(triples, dtype=object))
+    want = rows(allatonce.discover(ids, min_support))
+    s_dense, s_chunk = {}, {}
+    dense = rows(approximate.discover(ids, min_support, pair_backend="matmul",
+                                      stats=s_dense))
+    chunk = rows(approximate.discover(ids, min_support, pair_backend="chunked",
+                                      stats=s_chunk))
+    assert dense == want and chunk == want
+    assert s_dense["pair_backend"] == "matmul"
+    assert s_chunk["pair_backend"] == "chunked"
+    # Both backends account the same verification pair volume.
+    assert s_dense["pairs_verify"] == s_chunk["pairs_verify"]
+
+
+def test_dense_verify_bad_backend():
+    with pytest.raises(ValueError):
+        approximate.discover(np.ones((4, 3), np.int32), 1, pair_backend="nope")
+
+
 def test_association_rules_and_fc_flags():
     rng = random.Random(17)
     triples = random_triples(rng, 90, 9, 3, 6)
