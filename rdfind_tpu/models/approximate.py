@@ -236,6 +236,8 @@ def discover(triples, min_support: int, projections: str = "spo",
     whenever the membership matrix fits the HBM budget.  Round 1 (the sketch
     build and the candidate containment matmul) is backend-independent.
     """
+    if pair_backend not in ("auto", "matmul", "chunked"):
+        raise ValueError(f"unknown pair_backend {pair_backend!r}")
     min_support = max(int(min_support), 1)
     use_ars = use_association_rules and use_frequent_condition_filter
     st = prepare_join_lines(triples, min_support, projections,
@@ -257,40 +259,41 @@ def discover(triples, min_support: int, projections: str = "spo",
     if stats is not None:
         stats["n_sketch_candidates"] = len(cand_dep)
 
-    if pair_backend not in ("auto", "matmul", "chunked"):
-        raise ValueError(f"unknown pair_backend {pair_backend!r}")
-    cnt = None
-    if pair_backend in ("auto", "matmul") and len(cand_dep):
-        dep_ok = np.zeros(st["num_caps"], bool)
-        dep_ok[cand_dep] = True
-        ref_ok = np.zeros(st["num_caps"], bool)
-        ref_ok[cand_ref] = True
-        cnt = _dense_verify_counts(
-            st["line_val_h"], st["line_cap_h"], st["num_caps"],
-            cand_dep, cand_ref, dep_ok, ref_ok, stats, "pairs_verify")
-        if cnt is None and pair_backend == "matmul":
-            raise ValueError("pair_backend='matmul' but the dense plan "
-                             "does not fit the single-shot budget")
-
-    if cnt is not None:
-        sup_all = st["dep_count"][cand_dep]
-        is_cind = (cnt == sup_all) & (sup_all >= min_support)
-        is_cind &= ~small_to_large._implied_mask(
-            cand_dep, cand_ref, st["cap_code"], st["cap_v1"], st["cap_v2"])
-        d, r, sup = cand_dep[is_cind], cand_ref[is_cind], sup_all[is_cind]
+    if len(cand_dep) == 0:
+        # No sketch survivors: no pair phase runs on either backend.
+        d = r = sup = np.zeros(0, np.int64)
     else:
-        if stats is not None:
-            stats["pair_backend"] = "chunked"
+        cnt = None
+        if pair_backend in ("auto", "matmul"):
+            dep_ok = np.zeros(st["num_caps"], bool)
+            dep_ok[cand_dep] = True
+            ref_ok = np.zeros(st["num_caps"], bool)
+            ref_ok[cand_ref] = True
+            cnt = _dense_verify_counts(
+                st["line_val_h"], st["line_cap_h"], st["num_caps"],
+                cand_dep, cand_ref, dep_ok, ref_ok, stats, "pairs_verify")
+            if cnt is None and pair_backend == "matmul":
+                raise ValueError("pair_backend='matmul' but the dense plan "
+                                 "does not fit the single-shot budget")
+        if cnt is not None:
+            sup_all = st["dep_count"][cand_dep]
+            is_cind = (cnt == sup_all) & (sup_all >= min_support)
+            is_cind &= ~small_to_large._implied_mask(
+                cand_dep, cand_ref, st["cap_code"], st["cap_v1"], st["cap_v2"])
+            d, r, sup = cand_dep[is_cind], cand_ref[is_cind], sup_all[is_cind]
+        else:
+            if stats is not None:
+                stats["pair_backend"] = "chunked"
 
-        def cooc_fn(dep_ok, ref_ok, stat_key):
-            return small_to_large._chunked_cooc(
-                st["line_val_h"], st["line_cap_h"], dep_ok, ref_ok,
-                pair_chunk_budget, stats, stat_key)
+            def cooc_fn(dep_ok, ref_ok, stat_key):
+                return small_to_large._chunked_cooc(
+                    st["line_val_h"], st["line_cap_h"], dep_ok, ref_ok,
+                    pair_chunk_budget, stats, stat_key)
 
-        d, r, sup = small_to_large._verify_level(
-            cooc_fn, cand_dep, cand_ref, st["num_caps"], st["dep_count"],
-            st["cap_code"], st["cap_v1"], st["cap_v2"], min_support,
-            "pairs_verify")
+            d, r, sup = small_to_large._verify_level(
+                cooc_fn, cand_dep, cand_ref, st["num_caps"], st["dep_count"],
+                st["cap_code"], st["cap_v1"], st["cap_v2"], min_support,
+                "pairs_verify")
 
     cap_code, cap_v1, cap_v2 = st["cap_code"], st["cap_v1"], st["cap_v2"]
     table = CindTable(
