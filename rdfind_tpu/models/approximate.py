@@ -183,7 +183,6 @@ def _dense_verify_counts(line_val_h, line_cap_h, num_caps, cand_dep, cand_ref,
         tot = int((lens * (lens - 1)).sum())
         stats[stat_key] = stats.get(stat_key, 0) + tot
         stats["total_pairs"] = stats.get("total_pairs", 0) + tot
-        stats["pair_backend"] = "matmul"
 
     row_cap = segments.pow2_capacity(n)
     pad = allatonce._pad_np
@@ -213,6 +212,60 @@ def _dense_verify_counts(line_val_h, line_cap_h, num_caps, cand_dep, cand_ref,
     cnt = np.empty_like(cnt_sorted)
     cnt[order] = cnt_sorted
     return cnt
+
+
+def _record_backend(stats, stat_key, backend):
+    """Per-call backend attribution + a run-level scalar ("mixed" when a
+    multi-round strategy's rounds land on different backends)."""
+    if stats is None:
+        return
+    stats[stat_key + "_backend"] = backend
+    prev = stats.get("pair_backend")
+    stats["pair_backend"] = backend if prev in (None, backend) else "mixed"
+
+
+def verify_candidates(st, cand_dep, cand_ref, min_support, *, pair_backend,
+                      pair_chunk_budget, stats, stat_key):
+    """Exact verification of candidate (dep, ref) pairs: (d, r, sup) arrays.
+
+    Backend dispatch shared by the approximate and LateBB strategies: the
+    dense membership-matmul gather when the plan fits ("auto"/"matmul"),
+    otherwise the chunked host loop via _verify_level.
+    """
+    if len(cand_dep) == 0:
+        # No candidates: no pair phase runs on either backend.
+        z = np.zeros(0, np.int64)
+        return z, z, z
+    cnt = None
+    if pair_backend in ("auto", "matmul"):
+        dep_ok = np.zeros(st["num_caps"], bool)
+        dep_ok[cand_dep] = True
+        ref_ok = np.zeros(st["num_caps"], bool)
+        ref_ok[cand_ref] = True
+        cnt = _dense_verify_counts(
+            st["line_val_h"], st["line_cap_h"], st["num_caps"],
+            cand_dep, cand_ref, dep_ok, ref_ok, stats, stat_key)
+        if cnt is None and pair_backend == "matmul":
+            raise ValueError("pair_backend='matmul' but the dense plan "
+                             "does not fit the single-shot budget")
+    if cnt is not None:
+        _record_backend(stats, stat_key, "matmul")
+        sup_all = st["dep_count"][cand_dep]
+        is_cind = (cnt == sup_all) & (sup_all >= min_support)
+        is_cind &= ~small_to_large._implied_mask(
+            cand_dep, cand_ref, st["cap_code"], st["cap_v1"], st["cap_v2"])
+        return cand_dep[is_cind], cand_ref[is_cind], sup_all[is_cind]
+
+    _record_backend(stats, stat_key, "chunked")
+
+    def cooc_fn(dep_ok, ref_ok, key):
+        return small_to_large._chunked_cooc(
+            st["line_val_h"], st["line_cap_h"], dep_ok, ref_ok,
+            pair_chunk_budget, stats, key)
+
+    return small_to_large._verify_level(
+        cooc_fn, cand_dep, cand_ref, st["num_caps"], st["dep_count"],
+        st["cap_code"], st["cap_v1"], st["cap_v2"], min_support, stat_key)
 
 
 # Shared phase A lives with the staging code it drives.
@@ -259,41 +312,10 @@ def discover(triples, min_support: int, projections: str = "spo",
     if stats is not None:
         stats["n_sketch_candidates"] = len(cand_dep)
 
-    if len(cand_dep) == 0:
-        # No sketch survivors: no pair phase runs on either backend.
-        d = r = sup = np.zeros(0, np.int64)
-    else:
-        cnt = None
-        if pair_backend in ("auto", "matmul"):
-            dep_ok = np.zeros(st["num_caps"], bool)
-            dep_ok[cand_dep] = True
-            ref_ok = np.zeros(st["num_caps"], bool)
-            ref_ok[cand_ref] = True
-            cnt = _dense_verify_counts(
-                st["line_val_h"], st["line_cap_h"], st["num_caps"],
-                cand_dep, cand_ref, dep_ok, ref_ok, stats, "pairs_verify")
-            if cnt is None and pair_backend == "matmul":
-                raise ValueError("pair_backend='matmul' but the dense plan "
-                                 "does not fit the single-shot budget")
-        if cnt is not None:
-            sup_all = st["dep_count"][cand_dep]
-            is_cind = (cnt == sup_all) & (sup_all >= min_support)
-            is_cind &= ~small_to_large._implied_mask(
-                cand_dep, cand_ref, st["cap_code"], st["cap_v1"], st["cap_v2"])
-            d, r, sup = cand_dep[is_cind], cand_ref[is_cind], sup_all[is_cind]
-        else:
-            if stats is not None:
-                stats["pair_backend"] = "chunked"
-
-            def cooc_fn(dep_ok, ref_ok, stat_key):
-                return small_to_large._chunked_cooc(
-                    st["line_val_h"], st["line_cap_h"], dep_ok, ref_ok,
-                    pair_chunk_budget, stats, stat_key)
-
-            d, r, sup = small_to_large._verify_level(
-                cooc_fn, cand_dep, cand_ref, st["num_caps"], st["dep_count"],
-                st["cap_code"], st["cap_v1"], st["cap_v2"], min_support,
-                "pairs_verify")
+    d, r, sup = verify_candidates(
+        st, cand_dep, cand_ref, min_support, pair_backend=pair_backend,
+        pair_chunk_budget=pair_chunk_budget, stats=stats,
+        stat_key="pairs_verify")
 
     cap_code, cap_v1, cap_v2 = st["cap_code"], st["cap_v1"], st["cap_v2"]
     table = CindTable(

@@ -38,8 +38,16 @@ def discover(triples, min_support: int, projections: str = "spo",
              pair_chunk_budget: int = allatonce.PAIR_CHUNK_BUDGET,
              sketch_bits: int = sketch.DEFAULT_BITS,
              sketch_hashes: int = sketch.DEFAULT_HASHES,
+             pair_backend: str = "auto",
              stats: dict | None = None) -> CindTable:
-    """Discover CINDs in two rounds: unary dependents first, binary pruned after."""
+    """Discover CINDs in two rounds: unary dependents first, binary pruned after.
+
+    pair_backend selects each round's exact verification (see
+    approximate.discover): "matmul" = dense membership-matmul gather,
+    "chunked" = legacy host loop, "auto" = matmul when it fits.
+    """
+    if pair_backend not in ("auto", "matmul", "chunked"):
+        raise ValueError(f"unknown pair_backend {pair_backend!r}")
     min_support = max(int(min_support), 1)
     use_ars = use_association_rules and use_frequent_condition_filter
     st = approximate.prepare_join_lines(triples, min_support, projections,
@@ -64,15 +72,11 @@ def discover(triples, min_support: int, projections: str = "spo",
     dep_is_unary = unary[cand_dep]
 
     # Round 1: unary dependents, refs of both arities.
-    def cooc_fn(dep_ok, ref_ok, stat_key):
-        return small_to_large._chunked_cooc(
-            st["line_val_h"], st["line_cap_h"], dep_ok, ref_ok,
-            pair_chunk_budget, stats, stat_key)
-
     c1_dep, c1_ref = cand_dep[dep_is_unary], cand_ref[dep_is_unary]
-    d1, r1, sup1 = small_to_large._verify_level(
-        cooc_fn, c1_dep, c1_ref, num_caps, dep_count,
-        cap_code, cap_v1, cap_v2, min_support, "pairs_round1")
+    d1, r1, sup1 = approximate.verify_candidates(
+        st, c1_dep, c1_ref, min_support, pair_backend=pair_backend,
+        pair_chunk_budget=pair_chunk_budget, stats=stats,
+        stat_key="pairs_round1")
     if stats is not None:
         stats.update(n_round1_candidates=len(c1_dep), n_round1_cinds=len(d1))
 
@@ -83,9 +87,10 @@ def discover(triples, min_support: int, projections: str = "spo",
     keep = small_to_large._prune_22_vs_12(c2_dep, c2_ref, d1, r1,
                                           cap_code, cap_v1, cap_v2)
     c2_dep, c2_ref = c2_dep[keep], c2_ref[keep]
-    d2, r2, sup2 = small_to_large._verify_level(
-        cooc_fn, c2_dep, c2_ref, num_caps, dep_count,
-        cap_code, cap_v1, cap_v2, min_support, "pairs_round2")
+    d2, r2, sup2 = approximate.verify_candidates(
+        st, c2_dep, c2_ref, min_support, pair_backend=pair_backend,
+        pair_chunk_budget=pair_chunk_budget, stats=stats,
+        stat_key="pairs_round2")
     if stats is not None:
         stats.update(n_round2_candidates=len(c2_dep), n_round2_cinds=len(d2))
 

@@ -97,5 +97,16 @@ def test_with_flags():
         assert got == want, kw
 
 
+def test_dense_verify_matches_chunked():
+    # Both verification backends agree in both rounds (shared
+    # approximate.verify_candidates dispatch).
+    rng = random.Random(29)
+    triples = random_triples(rng, 160, 12, 4, 8)
+    dense = run_latebb(triples, 2, pair_backend="matmul")
+    chunk = run_latebb(triples, 2, pair_backend="chunked")
+    want = latebb_raw_from_allatonce(run_exact(triples, 2))
+    assert dense == want and chunk == want
+
+
 def test_empty():
     assert len(late_bb.discover(np.zeros((0, 3), np.int32), 1)) == 0
