@@ -951,8 +951,8 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
 
     `preshard=(global_triples, global_n_valid)` feeds pre-built global arrays
     (sharded multi-host ingest — runtime/multihost_ingest.py) instead of a
-    host triple table; `triples` is then ignored and may be None.  AR mining
-    needs the host table, so use_ars is unsupported with preshard.
+    host triple table; `triples` is then ignored and may be None.  With
+    preshard, AR mining runs distributed (mine_ars_sharded).
     """
     if mesh is None:
         mesh = make_mesh()
@@ -960,9 +960,6 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
         triples = np.asarray(triples, np.int32)
         if triples.shape[0] == 0:
             return CindTable.empty()
-    elif use_ars:
-        raise ValueError("use_ars requires a host triple table; "
-                         "unsupported with preshard")
     if not any(ch in projections for ch in "spo"):
         return CindTable.empty()
     min_support = max(int(min_support), 1)
@@ -980,7 +977,7 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
         support=support.astype(np.int64))
     if use_ars:
         from . import allatonce
-        rules = frequency.mine_association_rules(triples, min_support)
+        rules = _mine_rules(triples, preshard, min_support, mesh)
         if stats is not None:
             stats["association_rules"] = rules
         table = allatonce.filter_ar_implied_cinds(table, rules)
@@ -1188,18 +1185,22 @@ def _sharded_sketch_candidates(pipe, cap_table, bits, num_hashes, stats):
 def _check_preshard(triples, preshard, use_ars, use_fis):
     """Shared entry validation: host table XOR preshard global arrays.
 
-    With `preshard` (sharded multi-host ingest) no host holds the triple
-    table, so AR mining — which needs host rows — is rejected, matching
-    discover_sharded.  Returns (triples-as-int32-or-None, use_ars)."""
+    Returns (triples-as-int32-or-None, use_ars).  With `preshard` (sharded
+    multi-host ingest) AR mining runs distributed (mine_ars_sharded)."""
     if preshard is not None:
-        if use_ars and use_fis:
-            raise ValueError("use_ars requires a host triple table; "
-                             "unsupported with preshard")
-        return None, False
+        return None, use_ars and use_fis
     triples = np.asarray(triples, np.int32)
     if triples.shape[0] == 0:
         return None, use_ars and use_fis
     return triples, use_ars and use_fis
+
+
+def _mine_rules(triples, preshard, min_support, mesh):
+    """Rule table for the AR post-filter: host mining with a host triple
+    table, the distributed count-exchange miner over a preshard."""
+    if preshard is not None:
+        return mine_ars_sharded(preshard[0], preshard[1], min_support, mesh)
+    return frequency.mine_association_rules(triples, min_support)
 
 
 def _sharded_prep_approx(triples, min_support, mesh, projections, use_fis,
@@ -1228,7 +1229,7 @@ def _sharded_prep_approx(triples, min_support, mesh, projections, use_fis,
 
 
 def _finish_table(cap_table, d, r, sup, triples, min_support, use_ars,
-                  clean_implied, stats, mesh=None):
+                  clean_implied, stats, mesh=None, preshard=None):
     from . import allatonce
 
     cap_code, cap_v1, cap_v2, _ = cap_table
@@ -1237,7 +1238,7 @@ def _finish_table(cap_table, d, r, sup, triples, min_support, use_ars,
         ref_code=cap_code[r], ref_v1=cap_v1[r], ref_v2=cap_v2[r],
         support=sup)
     if use_ars:
-        rules = frequency.mine_association_rules(triples, min_support)
+        rules = _mine_rules(triples, preshard, min_support, mesh)
         if stats is not None:
             stats["association_rules"] = rules
         table = allatonce.filter_ar_implied_cinds(table, rules)
@@ -1282,7 +1283,7 @@ def discover_sharded_approx(triples, min_support: int, mesh=None,
         backend.cooc, cand_dep, cand_ref, cap_code.shape[0], dep_count,
         cap_code, cap_v1, cap_v2, min_support, "pairs_verify")
     return _finish_table(cap_table, d, r, sup, triples, min_support, use_ars,
-                         clean_implied, stats, mesh=mesh)
+                         clean_implied, stats, mesh=mesh, preshard=preshard)
 
 
 def discover_sharded_late_bb(triples, min_support: int, mesh=None,
@@ -1333,7 +1334,7 @@ def discover_sharded_late_bb(triples, min_support: int, mesh=None,
     return _finish_table(
         cap_table, np.concatenate([d1, d2]), np.concatenate([r1, r2]),
         np.concatenate([sup1, sup2]), triples, min_support, use_ars,
-        clean_implied, stats, mesh=mesh)
+        clean_implied, stats, mesh=mesh, preshard=preshard)
 
 
 def discover_sharded_s2l(triples, min_support: int, mesh=None,
@@ -1382,7 +1383,7 @@ def discover_sharded_s2l(triples, min_support: int, mesh=None,
 
     backend = _ShardedCooc(pipe, (cap_code, cap_v1, cap_v2, dep_count))
 
-    rules = (frequency.mine_association_rules(triples, min_support)
+    rules = (_mine_rules(triples, preshard, min_support, pipe.mesh)
              if use_ars else None)
     if use_ars and stats is not None:
         stats["association_rules"] = rules
@@ -1420,6 +1421,130 @@ def _stage_count_fcs(mesh, capacity: int, include_binary: bool):
     return jax.jit(jax.shard_map(
         f, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS), P()),
         out_specs=(P(), P())))
+
+
+@functools.lru_cache(maxsize=None)
+def _stage_join_histogram(mesh, capacity: int, projections: str):
+    """Compiled shard_map program: per-line distinct-capture counts over a
+    preshard (the distributed --create-join-histogram pass,
+    RDFind.scala:448-452 — an extra map/groupBy job, as in the reference)."""
+    def f(triples, n_valid):
+        t_loc = triples.shape[0]
+        valid = jnp.arange(t_loc, dtype=jnp.int32) < n_valid[0]
+        cands = emit_join_candidates(triples, frequency.no_filter(valid),
+                                     projections)
+        u_cols, u_valid, _, _ = segments.masked_unique(
+            [cands.join_val, cands.code, cands.v1, cands.v2], cands.valid)
+        d = jax.lax.psum(1, AXIS)
+        bucket = hashing.bucket_of([u_cols[0]], d, seed=433)
+        recv, recv_valid, ovf, _ = exchange.route(u_cols, u_valid, bucket,
+                                                  AXIS, capacity)
+        r_cols, r_valid, _, _ = segments.masked_unique(recv, recv_valid)
+        # masked_unique sorts by key, so each join value is one contiguous
+        # run at its owner: line size = run length, one representative per run.
+        sizes = segments.masked_row_counts([r_cols[0]], r_valid)
+        is_rep = segments.run_starts([r_cols[0]]) & r_valid
+        return jnp.where(is_rep, sizes, 0), ovf
+
+    return jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS)),
+        out_specs=(P(AXIS), P())))
+
+
+def join_histogram_sharded(g_triples, g_valid, projections: str, mesh,
+                           max_retries: int = 4):
+    """(line_size, occurrence_count) pairs over a preshard — output-identical
+    to the replicated driver's histogram on the same data."""
+    num_dev = mesh.devices.size
+    t_loc = g_triples.shape[0] // num_dev
+    capacity = _headroom(-(-9 * t_loc // num_dev))
+    for _ in range(max_retries):
+        prog = _stage_join_histogram(mesh, capacity, projections)
+        line_sizes, ovf = prog(g_triples, g_valid)
+        ovf = int(np.asarray(host_gather(ovf)).reshape(-1)[0])
+        if ovf == 0:
+            break
+        capacity = segments.pow2_capacity(2 * capacity + ovf)
+        _check_exchange_caps(num_dev, histogram=capacity)
+    else:
+        raise RuntimeError(
+            f"join-histogram exchange overflow persisted after "
+            f"{max_retries} retries (ovf={ovf})")
+    sizes_h = np.asarray(host_gather(line_sizes)).reshape(-1)
+    sizes_h = sizes_h[sizes_h > 0]
+    sizes, times = np.unique(sizes_h, return_counts=True)
+    return list(zip(sizes.tolist(), times.tolist()))
+
+
+@functools.lru_cache(maxsize=None)
+def _stage_mine_ars(mesh, cap_counts: int, cap_rules: int):
+    """Compiled shard_map program: distributed perfect-confidence AR mining.
+
+    The preshard form of frequency._stage_rules (FrequentConditionPlanner.
+    scala:130-194): per-row global counts come from the count exchange, rule
+    verdicts are local comparisons, and the distinct rule rows travel to their
+    hash owner for global dedupe — no host ever holds the triple table.
+    """
+    def f(triples, n_valid, min_support):
+        t_loc = triples.shape[0]
+        valid = jnp.arange(t_loc, dtype=jnp.int32) < n_valid[0]
+        ovf = jnp.int32(0)
+        unary, binary = [], []
+        for fld in range(3):
+            cnt, o = exchange.global_row_counts(
+                [triples[:, fld]], valid, AXIS, cap_counts, seed=401 + fld)
+            unary.append(cnt)
+            ovf += o
+        for k, (a, b) in enumerate(frequency._FIELD_PAIRS):
+            cnt, o = exchange.global_row_counts(
+                [triples[:, a], triples[:, b]], valid, AXIS, cap_counts,
+                seed=404 + k)
+            binary.append(cnt)
+            ovf += o
+        # Local distinct rules (the shared emitter), then one route to the
+        # key's hash owner; owners partition the rule space, so their
+        # distinct sets are globally disjoint.
+        u_cols, u_valid, _ = frequency.emit_rule_rows(
+            triples, valid, min_support, unary, binary)
+        d = jax.lax.psum(1, AXIS)
+        bucket = hashing.bucket_of(u_cols[:4], d, seed=419)
+        recv, recv_valid, o_r, _ = exchange.route(u_cols, u_valid, bucket,
+                                                  AXIS, cap_rules)
+        ovf += o_r
+        r_cols, r_valid, _, _ = segments.masked_unique(recv, recv_valid)
+        return (*r_cols, r_valid, ovf)
+
+    return jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS), P()),
+        out_specs=(*([P(AXIS)] * 6), P())))
+
+
+def mine_ars_sharded(g_triples, g_valid, min_support: int, mesh,
+                     max_retries: int = 4):
+    """Association rules over a preshard: same host rule table as
+    frequency.mine_association_rules, mined with count exchanges + one
+    rule-row route (no host triple table)."""
+    num_dev = mesh.devices.size
+    t_loc = g_triples.shape[0] // num_dev
+    cap_counts = _headroom(-(-t_loc // num_dev))
+    cap_rules = _headroom(CAP_FLOOR)
+    for _ in range(max_retries):
+        prog = _stage_mine_ars(mesh, cap_counts, cap_rules)
+        *cols, r_valid, ovf = prog(g_triples, g_valid,
+                                   jnp.int32(max(int(min_support), 1)))
+        ovf = int(np.asarray(host_gather(ovf)).reshape(-1)[0])
+        if ovf == 0:
+            break
+        cap_counts = segments.pow2_capacity(2 * cap_counts + ovf)
+        cap_rules = segments.pow2_capacity(2 * cap_rules + ovf)
+        _check_exchange_caps(num_dev, ar_counts=cap_counts,
+                             ar_rules=cap_rules)
+    else:
+        raise RuntimeError(
+            f"association-rule exchange overflow persisted after "
+            f"{max_retries} retries (ovf={ovf})")
+    keep = np.asarray(host_gather(r_valid))
+    return [np.asarray(host_gather(c))[keep] for c in cols]
 
 
 def count_fcs_sharded(g_triples, g_valid, min_support: int, mesh,

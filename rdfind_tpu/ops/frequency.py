@@ -79,6 +79,33 @@ def no_filter(valid) -> TripleFrequency:
     return TripleFrequency(ok, ok, jnp.zeros_like(ok))
 
 
+def emit_rule_rows(triples, valid, min_support, unary_counts, binary_counts):
+    """Distinct perfect-confidence rule rows from per-row condition counts.
+
+    Shared emitter for the host and the distributed miners (they differ only
+    in where counts come from: local segment counts vs the count exchange).
+    unary_counts[f] / binary_counts[k] are (N,) per-row counts of field f's
+    value / field-pair k's value pair.  Returns (cols, valid): five fixed-shape
+    columns (ant_bit, cons_bit, ant_val, cons_val, support) with the distinct
+    rule rows compacted to the front.
+    """
+    n = triples.shape[0]
+    parts = []
+    for k, (a, b) in enumerate(_FIELD_PAIRS):
+        cnt_ab = binary_counts[k]
+        for ant, con, cnt_u in ((a, b, unary_counts[a]), (b, a, unary_counts[b])):
+            is_rule = valid & (cnt_ab == cnt_u) & (cnt_u >= min_support)
+            parts.append((jnp.full(n, _FIELD_BITS[ant], jnp.int32),
+                          jnp.full(n, _FIELD_BITS[con], jnp.int32),
+                          triples[:, ant], triples[:, con], cnt_ab, is_rule))
+    cols = [jnp.concatenate([p[i] for p in parts]) for i in range(5)]
+    mask = jnp.concatenate([p[5] for p in parts])
+    # Support (cnt_ab) is constant within a rule group, so it can ride along as a
+    # fifth key column without affecting uniqueness.
+    (full_cols, u_valid, _, n_rules) = segments.masked_unique(cols, mask)
+    return full_cols, u_valid, n_rules
+
+
 @jax.jit
 def _stage_rules(triples, n_valid, min_support):
     """All perfect-confidence association rules, compacted to the front.
@@ -90,21 +117,12 @@ def _stage_rules(triples, n_valid, min_support):
     """
     n = triples.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_valid
-    parts = []
-    for a, b in _FIELD_PAIRS:
-        cnt_a = segments.masked_row_counts([triples[:, a]], valid)
-        cnt_b = segments.masked_row_counts([triples[:, b]], valid)
-        cnt_ab = segments.masked_row_counts([triples[:, a], triples[:, b]], valid)
-        for ant, con, cnt_u in ((a, b, cnt_a), (b, a, cnt_b)):
-            is_rule = valid & (cnt_ab == cnt_u) & (cnt_u >= min_support)
-            parts.append((jnp.full(n, _FIELD_BITS[ant], jnp.int32),
-                          jnp.full(n, _FIELD_BITS[con], jnp.int32),
-                          triples[:, ant], triples[:, con], cnt_ab, is_rule))
-    cols = [jnp.concatenate([p[i] for p in parts]) for i in range(5)]
-    mask = jnp.concatenate([p[5] for p in parts])
-    # Support (cnt_ab) is constant within a rule group, so it can ride along as a
-    # fifth key column without affecting uniqueness.
-    (full_cols, _, _, n_rules) = segments.masked_unique(cols, mask)
+    unary = [segments.masked_row_counts([triples[:, f]], valid)
+             for f in range(3)]
+    binary = [segments.masked_row_counts([triples[:, a], triples[:, b]], valid)
+              for a, b in _FIELD_PAIRS]
+    full_cols, _, n_rules = emit_rule_rows(triples, valid, min_support,
+                                           unary, binary)
     return (*full_cols, n_rules)
 
 

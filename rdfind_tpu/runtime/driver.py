@@ -335,9 +335,6 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
     unsupported = [
         (cfg.checkpoint_dir is not None, "--checkpoint-dir"),
         (cfg.only_read or cfg.only_join, "--only-read/--do-only-join"),
-        (cfg.use_association_rules, "--use-ars"),
-        (cfg.ar_output_file is not None, "--ar-output"),
-        (cfg.create_join_histogram, "--create-join-histogram"),
     ]
     bad = [name for cond, name in unsupported if cond]
     if bad:
@@ -386,6 +383,17 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
             return out[:2]
         g_triples, g_valid = phases.run("distinct", dedupe)
 
+    if cfg.create_join_histogram:
+        # Distributed join-line size histogram (RDFind.scala:448-452): an
+        # extra pass over the preshard, like the reference's extra job.
+        def histogram():
+            hist = sharded.join_histogram_sharded(
+                g_triples, g_valid, cfg.projections, mesh)
+            if _is_primary():
+                for size, times in hist:
+                    print(f"Join size {size} encountered {times}x")
+        phases.run("join-histogram", histogram)
+
     if cfg.find_only_fcs >= 1:
         # Distributed frequent-condition report over the preshard (level
         # semantics as in the replicated path: >= 1 unary, >= 2 adds binary).
@@ -396,10 +404,20 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
             counters["frequent-single-conditions"] = n_unary
             if n_binary is not None:
                 counters["frequent-double-conditions"] = n_binary
+                if cfg.use_association_rules and cfg.use_frequent_item_set:
+                    rules = sharded.mine_ars_sharded(
+                        g_triples, g_valid, cfg.min_support, mesh)
+                    counters["association-rules"] = len(rules[0])
         phases.run("frequent-conditions", mine_fcs)
         _report(cfg, counters, phases.timings)
         return RunResult(CindTable.empty(), dictionary, None, counters,
                          phases.timings)
+
+    if cfg.use_association_rules and not cfg.use_frequent_item_set:
+        # Parity with the replicated path's note (RDFind.scala:290-296).
+        print("note: --use-ars has no effect without --use-fis "
+              "(association rules are mined from the frequent-item sets)",
+              file=sys.stderr)
 
     stats: dict = {}
     skew = _skew_from_cfg(cfg)
@@ -419,16 +437,29 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
         None, cfg.min_support, mesh=mesh, skew=skew,
         combine=cfg.combinable_join, projections=cfg.projections,
         use_fis=cfg.use_frequent_item_set,
+        use_ars=cfg.use_association_rules,
         clean_implied=cfg.clean_implied, stats=stats,
         preshard=(g_triples, g_valid)))
     counters["cind-counter"] = len(table)
+    if (cfg.ar_output_file and cfg.use_frequent_item_set
+            and "association_rules" not in stats):
+        # --ar-output without --use-ars: rules were not mined during
+        # discovery; mine them over the preshard (no host triple table).
+        stats["association_rules"] = phases.run(
+            "mine-ars", lambda: sharded.mine_ars_sharded(
+                g_triples, g_valid, cfg.min_support, mesh))
     counters.update({f"stat-{k}": v for k, v in stats.items()})
     if isinstance(dictionary, multihost_ingest.PartitionedDictionary):
         # Hash-partitioned interning: no host holds the union, so decoding the
         # final CINDs is a collective every host joins (the strings needed are
-        # only the output's condition values — tiny next to the dictionary).
-        dictionary = phases.run("resolve-dictionary",
-                                lambda: dictionary.resolve_table(table))
+        # the output's condition values plus any mined rule values — tiny
+        # next to the dictionary).
+        rules = stats.get("association_rules")
+        extra = (np.concatenate([rules[2], rules[3]])
+                 if rules is not None else None)
+        dictionary = phases.run(
+            "resolve-dictionary",
+            lambda: dictionary.resolve_table(table, extra_ids=extra))
     _emit_sinks(cfg, phases, counters, table, dictionary, stats, None)
     _report(cfg, counters, phases.timings)
     return RunResult(table, dictionary, None, counters, phases.timings)
@@ -692,7 +723,7 @@ def _emit_sinks(cfg: Config, phases: _Phases, counters: dict, table,
                 from ..ops import frequency as freq_ops
                 mined = freq_ops.mine_association_rules(ids, cfg.min_support)
                 # (ids is always present here: the sharded-ingest path
-                # rejects --use-ars up front.)
+                # pre-mines rules into stats before _emit_sinks.)
             ants, cons, avs, cvs, sups = mined
             counters["association-rules"] = len(ants)
             from .. import conditions as cc

@@ -181,11 +181,14 @@ class PartitionedDictionary:
             mapping.update(zip(id_arr.tolist(), val_arr.tolist()))
         return ResolvedDictionary(mapping, len(self))
 
-    def resolve_table(self, table) -> "ResolvedDictionary":
-        """Collective: the view covering a CindTable's condition values."""
-        return self.resolve(np.concatenate([
-            np.asarray(c, np.int64) for c in
-            (table.dep_v1, table.dep_v2, table.ref_v1, table.ref_v2)]))
+    def resolve_table(self, table, extra_ids=None) -> "ResolvedDictionary":
+        """Collective: the view covering a CindTable's condition values
+        (plus `extra_ids`, e.g. mined association-rule values)."""
+        cols = [np.asarray(c, np.int64) for c in
+                (table.dep_v1, table.dep_v2, table.ref_v1, table.ref_v2)]
+        if extra_ids is not None:
+            cols.append(np.asarray(extra_ids, np.int64).reshape(-1))
+        return self.resolve(np.concatenate(cols))
 
 
 @dataclasses.dataclass

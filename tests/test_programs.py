@@ -285,8 +285,31 @@ def test_rdfind_sharded_ingest_single_process(tmp_path, capsys):
 
 
 def test_rdfind_sharded_ingest_rejects_incompatible(tmp_path):
+    # ARs and the join histogram are distributed now; what still needs the
+    # full host triple table is checkpointing and the read/join-only probes.
     f = tmp_path / "x.nt"
     f.write_text("<a> <p> <x> .\n")
     with pytest.raises(ValueError, match="sharded-ingest does not support"):
-        rdfind.main([str(f), "--sharded-ingest", "--use-fis", "--use-ars",
+        rdfind.main([str(f), "--sharded-ingest", "--only-read",
                      "--support", "1", "--traversal-strategy", "0"])
+    with pytest.raises(ValueError, match="sharded-ingest does not support"):
+        rdfind.main([str(f), "--sharded-ingest", "--checkpoint-dir",
+                     str(tmp_path / "ck"), "--support", "1",
+                     "--traversal-strategy", "0"])
+
+
+def test_rdfind_sharded_ingest_use_ars(tmp_path):
+    """--sharded-ingest --use-ars mines rules distributed and suppresses the
+    same AR-implied CINDs as the replicated path."""
+    f = tmp_path / "ar.nt"
+    rows = [f"<s{i}> <born> <town{i % 2}> .\n<s{i}> <lives> <town{i % 2}> .\n"
+            for i in range(4)]
+    f.write_text("".join(rows))
+    args = [str(f), "--support", "2", "--use-fis", "--use-ars",
+            "--traversal-strategy", "0",
+            "--output", str(tmp_path / "{}.tsv")]
+    assert rdfind.main([a.format("rep") for a in args]) == 0
+    assert rdfind.main([a.format("sh") for a in args] + ["--sharded-ingest"]) == 0
+    rep = sorted((tmp_path / "rep.tsv").read_text().splitlines())
+    sh = sorted((tmp_path / "sh.tsv").read_text().splitlines())
+    assert rep == sh and len(rep) > 0

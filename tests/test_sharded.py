@@ -417,3 +417,57 @@ def test_host_capture_budget_guard(mesh8, monkeypatch):
     triples = generate_triples(100, seed=2, n_predicates=4, n_entities=16)
     with pytest.raises(ValueError, match="lattice budget"):
         sharded.discover_sharded_s2l(triples, 2, mesh=mesh8)
+
+
+def _make_preshard(ids, mesh):
+    """Single-process preshard: rows split contiguously, per-device valid
+    prefixes (the layout sharded_ingest assembles across hosts)."""
+    from rdfind_tpu.ops import segments
+    from rdfind_tpu.parallel.mesh import make_global
+
+    ids = np.asarray(ids, np.int32)
+    d = mesh.devices.size
+    n = ids.shape[0]
+    t_loc = max(sharded.T_LOC_FLOOR, segments.pow2_capacity(-(-n // d)))
+    padded = np.zeros((t_loc * d, 3), np.int32)
+    padded[:n] = ids
+    n_valid = np.clip(n - np.arange(d) * t_loc, 0, t_loc).astype(np.int32)
+    return make_global(padded, mesh), make_global(n_valid, mesh)
+
+
+def test_preshard_use_ars_matches_host_mining(mesh8):
+    """Distributed AR mining over a preshard == host mining: same rule table,
+    same AR-filtered CINDs (the lifted --sharded-ingest --use-ars path)."""
+    from rdfind_tpu.ops import frequency
+
+    rng = random.Random(31)
+    rows = random_triples(rng, 80, 5, 3, 4)
+    rows += [("s_ar", "p_ar", f"o{i}") for i in range(4)] * 3  # a real rule
+    ids, _ = intern_triples(np.asarray(rows, dtype=object))
+    g_triples, g_valid = _make_preshard(ids, mesh8)
+
+    want_rules = frequency.mine_association_rules(ids, 2)
+    got_rules = sharded.mine_ars_sharded(g_triples, g_valid, 2, mesh8)
+    to_set = lambda cols: {tuple(int(c[i]) for c in cols)
+                           for i in range(len(cols[0]))}
+    assert to_set(got_rules) == to_set(want_rules)
+    assert len(want_rules[0]) > 0  # the fixture really mines rules
+
+    for fn in (sharded.discover_sharded, sharded.discover_sharded_s2l,
+               sharded.discover_sharded_approx,
+               sharded.discover_sharded_late_bb):
+        want = fn(ids, 2, mesh=mesh8, use_fis=True, use_ars=True).to_rows()
+        got = fn(None, 2, mesh=mesh8, use_fis=True, use_ars=True,
+                 preshard=(g_triples, g_valid)).to_rows()
+        assert got == want, fn.__name__
+
+
+def test_join_histogram_sharded_matches_host(mesh8):
+    from rdfind_tpu.runtime.driver import _join_histogram
+
+    triples = generate_triples(200, seed=12, n_predicates=6, n_entities=24)
+    ids = np.asarray(triples, np.int32)
+    g_triples, g_valid = _make_preshard(ids, mesh8)
+    got = sharded.join_histogram_sharded(g_triples, g_valid, "spo", mesh8)
+    want = _join_histogram(ids, "spo")
+    assert got == want
