@@ -328,12 +328,24 @@ def _trivial_cind_mask(table: CindTable) -> np.ndarray:
     return same_proj & sub & v_ok
 
 
+def _all_hosts_agree(flag: bool) -> bool:
+    """True iff `flag` is True on EVERY host (one tiny DCN allgather)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return flag
+    from jax.experimental import multihost_utils
+
+    hits = np.asarray(multihost_utils.process_allgather(
+        np.asarray([flag], np.int32))).reshape(-1)
+    return bool(hits.min())
+
+
 def _run_sharded_ingest(cfg: Config, phases: _Phases,
                         counters: dict) -> RunResult:
     """Multi-host sharded ingest + preshard discovery (each host parses only
     its file subset; no host materializes the full triple table)."""
     unsupported = [
-        (cfg.checkpoint_dir is not None, "--checkpoint-dir"),
         (cfg.only_read or cfg.only_join, "--only-read/--do-only-join"),
     ]
     bad = [name for cond, name in unsupported if cond]
@@ -363,13 +375,35 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
                 v = f(v)
             return v
 
+    ckpt = discover_fp = None
+    ingest_fp = ""
+    if cfg.checkpoint_dir:
+        import jax
+
+        # Per-host ingest cache + an all-hosts-agree discover checkpoint.
+        # The fingerprints extend the replicated payloads with the sharded
+        # layout knobs (host count and interning change the artifacts).
+        native_eff = multihost_ingest.native_parse_eligible(
+            cfg.native_ingest, transform, cfg.encoding)
+        fp0, dfp0 = _checkpoint_fps(cfg, native_eff)
+        sharded_extra = dict(sharded=True, num_hosts=jax.process_count(),
+                             interning=cfg.interning)
+        ckpt = checkpoint.CheckpointStore(cfg.checkpoint_dir)
+        ingest_fp = checkpoint.fingerprint({"base": fp0, **sharded_extra})
+        discover_fp = checkpoint.fingerprint({"base": dfp0, **sharded_extra})
+
     def ingest():
-        return multihost_ingest.sharded_ingest(
+        hit: list = []
+        out = multihost_ingest.sharded_ingest(
             paths, mesh, tabs=cfg.tabs, expect_quad=is_nq,
             encoding=cfg.encoding, use_native=cfg.native_ingest,
             partition_dictionary={"auto": None, "partitioned": True,
                                   "replicated": False}[cfg.interning],
-            transform=transform)
+            transform=transform, cache=ckpt, cache_fp=ingest_fp,
+            cache_hit=hit)
+        if hit and hit[0]:
+            counters["resumed-ingest"] = 1
+        return out
 
     g_triples, g_valid, dictionary, total = phases.run("sharded-ingest",
                                                        ingest)
@@ -413,7 +447,8 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
         return RunResult(CindTable.empty(), dictionary, None, counters,
                          phases.timings)
 
-    if cfg.use_association_rules and not cfg.use_frequent_item_set:
+    if (cfg.use_association_rules and not cfg.use_frequent_item_set
+            and _is_primary()):
         # Parity with the replicated path's note (RDFind.scala:290-296).
         print("note: --use-ars has no effect without --use-fis "
               "(association rules are mined from the frequent-item sets)",
@@ -433,13 +468,37 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
     if discover_fn is None:
         raise ValueError(
             f"unknown traversal strategy {cfg.traversal_strategy}")
-    table = phases.run("discover", lambda: discover_fn(
-        None, cfg.min_support, mesh=mesh, skew=skew,
-        combine=cfg.combinable_join, projections=cfg.projections,
-        use_fis=cfg.use_frequent_item_set,
-        use_ars=cfg.use_association_rules,
-        clean_implied=cfg.clean_implied, stats=stats,
-        preshard=(g_triples, g_valid)))
+    table = None
+    if ckpt is not None:
+        import jax
+
+        # Per-host stage file: hosts sharing one checkpoint dir must not race
+        # on a common tmp path, and hosts with private dirs must each hold a
+        # copy for the all-hosts-agree resume below.
+        discover_stage = f"discover-host{jax.process_index()}"
+        stored = ckpt.load(discover_stage, discover_fp)
+        # Discovery is collective: resume ONLY when every host hit, or the
+        # misses would enter the collectives alone and deadlock.
+        hit = _all_hosts_agree(stored is not None)
+        if hit:
+            table = phases.run("resume-discover",
+                               lambda: checkpoint.decode_cinds(stored))
+            stats.update(checkpoint.decode_stats(stored))
+            counters["resumed-discover"] = 1
+    if table is None:
+        table = phases.run("discover", lambda: discover_fn(
+            None, cfg.min_support, mesh=mesh, skew=skew,
+            combine=cfg.combinable_join, projections=cfg.projections,
+            use_fis=cfg.use_frequent_item_set,
+            use_ars=cfg.use_association_rules,
+            clean_implied=cfg.clean_implied, stats=stats,
+            preshard=(g_triples, g_valid)))
+        if ckpt is not None:
+            def save_discover():
+                arrays = checkpoint.encode_cinds(table)
+                arrays.update(checkpoint.encode_stats(stats))
+                ckpt.save(discover_stage, discover_fp, arrays)
+            phases.run("checkpoint-discover", save_discover)
     counters["cind-counter"] = len(table)
     if (cfg.ar_output_file and cfg.use_frequent_item_set
             and "association_rules" not in stats):

@@ -33,6 +33,15 @@ def shard_paths(paths: list[str], num_hosts: int, host_index: int) -> list[str]:
     return paths[host_index::num_hosts]
 
 
+def native_parse_eligible(use_native: bool, transform, encoding) -> bool:
+    """Single source of truth for "the fused C++ parser handles this config"
+    — shared with the driver's checkpoint fingerprint, which must record the
+    parser actually used (a cached parse from one parser must not silently
+    satisfy a run using the other)."""
+    return (transform is None and use_native and native.available()
+            and reader.is_utf8(encoding))
+
+
 def _local_ingest(paths, tabs: bool, expect_quad: bool, encoding,
                   use_native: bool = True, transform=None):
     """This host's file subset -> (local (N,3) int32 ids, local Dictionary).
@@ -43,8 +52,7 @@ def _local_ingest(paths, tabs: bool, expect_quad: bool, encoding,
     """
     if not paths:
         return np.zeros((0, 3), np.int32), Dictionary(np.zeros(0, object))
-    if transform is None and use_native and native.available() \
-            and reader.is_utf8(encoding):
+    if native_parse_eligible(use_native, transform, encoding):
         return native.ingest_files(paths, tabs=tabs, expect_quad=expect_quad)
     from ..dictionary import intern_triples
 
@@ -256,7 +264,8 @@ def sharded_ingest(paths: list[str], mesh, *, tabs: bool = False,
                    expect_quad: bool = False, encoding="utf-8",
                    use_native: bool = True,
                    partition_dictionary: bool | None = None,
-                   transform=None):
+                   transform=None, cache=None, cache_fp: str = "",
+                   cache_hit=None):
     """Multi-host ingest over `mesh`.
 
     Returns (global_triples, global_n_valid, dictionary, total_triples):
@@ -265,6 +274,12 @@ def sharded_ingest(paths: list[str], mesh, *, tabs: bool = False,
     PartitionedDictionary (multi-host default: each host stores only its
     crc32 hash range — decode via its collective `resolve`) or, with
     ``partition_dictionary=False`` / single-host, the replicated Dictionary.
+
+    `cache` (a checkpoint.CheckpointStore) checkpoints THIS host's local
+    parse (rows + local values) under `cache_fp`; the interning exchange and
+    the donation re-run on resume (they are collectives every host must join
+    anyway, and a per-host cache miss elsewhere must not deadlock them).
+    `cache_hit`, when a list, receives True/False for this host's load.
     """
     import jax
     from jax.experimental import multihost_utils
@@ -278,9 +293,23 @@ def sharded_ingest(paths: list[str], mesh, *, tabs: bool = False,
     num_hosts = jax.process_count()
     host_index = jax.process_index()
     my_paths = shard_paths(paths, num_hosts, host_index)
-    local_ids, local_dict = _local_ingest(my_paths, tabs, expect_quad,
-                                          encoding, use_native,
-                                          transform=transform)
+    local_ids = None
+    if cache is not None:
+        from . import checkpoint as ckpt_mod
+
+        stage = f"ingest-host{host_index}"
+        stored = cache.load(stage, cache_fp)
+        if stored is not None:
+            local_ids, local_dict = ckpt_mod.decode_ingest(stored)
+        if cache_hit is not None:
+            cache_hit.append(stored is not None)
+    if local_ids is None:
+        local_ids, local_dict = _local_ingest(my_paths, tabs, expect_quad,
+                                              encoding, use_native,
+                                              transform=transform)
+        if cache is not None:
+            cache.save(stage, cache_fp,
+                       ckpt_mod.encode_ingest(local_ids, local_dict))
 
     if partition_dictionary is None:
         partition_dictionary = num_hosts > 1

@@ -285,17 +285,32 @@ def test_rdfind_sharded_ingest_single_process(tmp_path, capsys):
 
 
 def test_rdfind_sharded_ingest_rejects_incompatible(tmp_path):
-    # ARs and the join histogram are distributed now; what still needs the
-    # full host triple table is checkpointing and the read/join-only probes.
+    # ARs, the join histogram, and checkpointing are distributed now; what
+    # still needs the full host triple table is the read/join-only probes.
     f = tmp_path / "x.nt"
     f.write_text("<a> <p> <x> .\n")
     with pytest.raises(ValueError, match="sharded-ingest does not support"):
         rdfind.main([str(f), "--sharded-ingest", "--only-read",
                      "--support", "1", "--traversal-strategy", "0"])
-    with pytest.raises(ValueError, match="sharded-ingest does not support"):
-        rdfind.main([str(f), "--sharded-ingest", "--checkpoint-dir",
-                     str(tmp_path / "ck"), "--support", "1",
-                     "--traversal-strategy", "0"])
+
+
+def test_rdfind_sharded_ingest_checkpoint_resume(tmp_path, capsys):
+    """Second --sharded-ingest run resumes both the per-host ingest cache and
+    the discover checkpoint, with identical output."""
+    f = tmp_path / "c.nt"
+    f.write_text("".join(f"<s{i % 3}> <p> <o{i % 2}> .\n" for i in range(12)))
+    args = [str(f), "--support", "2", "--sharded-ingest", "--counters", "1",
+            "--checkpoint-dir", str(tmp_path / "ck"),
+            "--output", str(tmp_path / "{}.tsv")]
+    assert rdfind.main([a.format("first") for a in args]) == 0
+    first_err = capsys.readouterr().err
+    assert "resumed-ingest" not in first_err
+    assert rdfind.main([a.format("second") for a in args]) == 0
+    second_err = capsys.readouterr().err
+    assert "resumed-ingest: 1" in second_err
+    assert "resumed-discover: 1" in second_err
+    assert ((tmp_path / "first.tsv").read_text()
+            == (tmp_path / "second.tsv").read_text())
 
 
 def test_rdfind_sharded_ingest_use_ars(tmp_path):

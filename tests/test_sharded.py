@@ -420,18 +420,12 @@ def test_host_capture_budget_guard(mesh8, monkeypatch):
 
 
 def _make_preshard(ids, mesh):
-    """Single-process preshard: rows split contiguously, per-device valid
-    prefixes (the layout sharded_ingest assembles across hosts)."""
-    from rdfind_tpu.ops import segments
+    """Single-process preshard via the production layout helper (the same
+    contiguous split + per-device valid prefixes sharded_ingest assembles)."""
     from rdfind_tpu.parallel.mesh import make_global
 
-    ids = np.asarray(ids, np.int32)
-    d = mesh.devices.size
-    n = ids.shape[0]
-    t_loc = max(sharded.T_LOC_FLOOR, segments.pow2_capacity(-(-n // d)))
-    padded = np.zeros((t_loc * d, 3), np.int32)
-    padded[:n] = ids
-    n_valid = np.clip(n - np.arange(d) * t_loc, 0, t_loc).astype(np.int32)
+    padded, n_valid, _ = sharded._shard_triples(np.asarray(ids, np.int32),
+                                                mesh.devices.size)
     return make_global(padded, mesh), make_global(n_valid, mesh)
 
 
