@@ -111,22 +111,50 @@ def decode_cinds(arrays: dict) -> CindTable:
     return CindTable(*(arrays[c] for c in _CIND_COLS))
 
 
+def _jsonable(v):
+    """JSON-ready copy of a stats value, or None when it has no JSON form."""
+    if isinstance(v, (bool, str)):
+        return v
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        return float(v)
+    if isinstance(v, dict):
+        out = {}
+        for k, x in v.items():
+            enc = _jsonable(x)
+            if enc is None:
+                return None
+            out[str(k)] = enc
+        return out
+    return None
+
+
 def encode_stats(stats: dict) -> dict:
-    """Scalar pipeline stats ride along with the discover stage so resumed runs
-    report the same stat-* counters as the run that produced the checkpoint."""
+    """Pipeline stats ride along with the discover stage so resumed runs
+    report the same stat-* counters as the run that produced the checkpoint.
+    JSON-representable values (scalars and nested dicts of scalars, e.g.
+    planned_caps) go into one blob; the association-rule table (numpy
+    columns) is stored as npz arrays."""
     scalars = {}
     for k, v in stats.items():
-        if isinstance(v, (bool, str)):
-            scalars[k] = v
-        elif isinstance(v, (int, np.integer)):
-            scalars[k] = int(v)
-        elif isinstance(v, (float, np.floating)):
-            scalars[k] = float(v)
+        enc = _jsonable(v)
+        if enc is not None:
+            scalars[k] = enc
     blob = json.dumps(scalars, sort_keys=True).encode()
-    return {"__stats__": np.frombuffer(blob, np.uint8)}
+    out = {"__stats__": np.frombuffer(blob, np.uint8)}
+    rules = stats.get("association_rules")
+    if rules is not None:
+        for i, col in enumerate(rules):
+            out[f"__rules_{i}__"] = np.asarray(col)
+    return out
 
 
 def decode_stats(arrays: dict) -> dict:
     if "__stats__" not in arrays:
         return {}
-    return json.loads(bytes(arrays["__stats__"]).decode())
+    stats = json.loads(bytes(arrays["__stats__"]).decode())
+    if "__rules_0__" in arrays:
+        stats["association_rules"] = [
+            arrays[f"__rules_{i}__"] for i in range(5)]
+    return stats

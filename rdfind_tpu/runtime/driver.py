@@ -143,8 +143,8 @@ def load_triples(cfg: Config, phases: _Phases, counters: dict):
     return triples
 
 
-def _checkpoint_fps(cfg: Config, use_native: bool):
-    """(ingest_fp, discover_fp): digests of everything feeding each stage."""
+def _checkpoint_payloads(cfg: Config, use_native: bool):
+    """(ingest_payload, discover_payload): everything feeding each stage."""
     paths, is_nq = _resolve_inputs(cfg)
     ingest_payload = dict(
         inputs=checkpoint.input_signature(paths), is_nq=is_nq, tabs=cfg.tabs,
@@ -168,8 +168,14 @@ def _checkpoint_fps(cfg: Config, use_native: bool):
         discover_payload.update(explicit_threshold=cfg.explicit_threshold,
                                 sbf_bits=cfg.sbf_bits)
     # balanced_11 is output-neutral, so it never enters the fingerprint.
-    return checkpoint.fingerprint(ingest_payload), checkpoint.fingerprint(
-        discover_payload)
+    return ingest_payload, discover_payload
+
+
+def _checkpoint_fps(cfg: Config, use_native: bool):
+    """(ingest_fp, discover_fp): digests of everything feeding each stage."""
+    ingest_payload, discover_payload = _checkpoint_payloads(cfg, use_native)
+    return (checkpoint.fingerprint(ingest_payload),
+            checkpoint.fingerprint(discover_payload))
 
 
 def _join_histogram(ids: np.ndarray, projections: str):
@@ -345,15 +351,6 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
                         counters: dict) -> RunResult:
     """Multi-host sharded ingest + preshard discovery (each host parses only
     its file subset; no host materializes the full triple table)."""
-    unsupported = [
-        (cfg.only_read or cfg.only_join, "--only-read/--do-only-join"),
-    ]
-    bad = [name for cond, name in unsupported if cond]
-    if bad:
-        raise ValueError(
-            f"--sharded-ingest does not support {', '.join(bad)} (these need "
-            f"the full host triple table; use the replicated ingest)")
-
     from . import multihost_ingest
 
     paths, is_nq = _resolve_inputs(cfg)
@@ -385,12 +382,20 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
         # layout knobs (host count and interning change the artifacts).
         native_eff = multihost_ingest.native_parse_eligible(
             cfg.native_ingest, transform, cfg.encoding)
-        fp0, dfp0 = _checkpoint_fps(cfg, native_eff)
+        ingest_payload, discover_payload = _checkpoint_payloads(cfg,
+                                                               native_eff)
+        # The cached artifact is the PRE-dedup local parse (dedupe_preshard
+        # runs after ingest on every run), so --distinct-triples must not
+        # invalidate it; discovery output still depends on it, so `distinct`
+        # stays in the discover payload's embedded copy.
+        cache_payload = {k: v for k, v in ingest_payload.items()
+                         if k != "distinct"}
         sharded_extra = dict(sharded=True, num_hosts=jax.process_count(),
                              interning=cfg.interning)
         ckpt = checkpoint.CheckpointStore(cfg.checkpoint_dir)
-        ingest_fp = checkpoint.fingerprint({"base": fp0, **sharded_extra})
-        discover_fp = checkpoint.fingerprint({"base": dfp0, **sharded_extra})
+        ingest_fp = checkpoint.fingerprint({**cache_payload, **sharded_extra})
+        discover_fp = checkpoint.fingerprint({**discover_payload,
+                                              **sharded_extra})
 
     def ingest():
         hit: list = []
@@ -401,7 +406,10 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
                                   "replicated": False}[cfg.interning],
             transform=transform, cache=ckpt, cache_fp=ingest_fp,
             cache_hit=hit)
-        if hit and hit[0]:
+        # The counter means "the run skipped parsing" — only true when EVERY
+        # host hit its cache (some hosts re-parsing is a partial resume the
+        # primary's report must not overstate).
+        if hit and _all_hosts_agree(hit[0]):
             counters["resumed-ingest"] = 1
         return out
 
@@ -409,6 +417,13 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
                                                        ingest)
     counters["input-triples"] = total
     counters["distinct-values"] = len(dictionary)
+
+    if cfg.only_read:
+        # The read-only probe (replicated-path parity; note the sharded ingest
+        # interns as it parses, so "read" includes interning here).
+        _report(cfg, counters, phases.timings)
+        return RunResult(CindTable.empty(), dictionary, None, counters,
+                         phases.timings)
 
     if cfg.distinct_triples:
         def dedupe():
@@ -427,6 +442,13 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
                 for size, times in hist:
                     print(f"Join size {size} encountered {times}x")
         phases.run("join-histogram", histogram)
+
+    if cfg.only_join:
+        # Replicated-path parity: stop before discovery (RDFind's join-only
+        # measurement probe).
+        _report(cfg, counters, phases.timings)
+        return RunResult(CindTable.empty(), dictionary, None, counters,
+                         phases.timings)
 
     if cfg.find_only_fcs >= 1:
         # Distributed frequent-condition report over the preshard (level

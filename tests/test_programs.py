@@ -284,23 +284,31 @@ def test_rdfind_sharded_ingest_single_process(tmp_path, capsys):
     assert (tmp_path / "a.txt").read_text() == (tmp_path / "b.txt").read_text()
 
 
-def test_rdfind_sharded_ingest_rejects_incompatible(tmp_path):
-    # ARs, the join histogram, and checkpointing are distributed now; what
-    # still needs the full host triple table is the read/join-only probes.
+def test_rdfind_sharded_ingest_probes(tmp_path, capsys):
+    """Every flag the sharded-ingest path once rejected now runs: the
+    read-only and join-only probes stop at the same milestones as the
+    replicated path."""
     f = tmp_path / "x.nt"
-    f.write_text("<a> <p> <x> .\n")
-    with pytest.raises(ValueError, match="sharded-ingest does not support"):
-        rdfind.main([str(f), "--sharded-ingest", "--only-read",
-                     "--support", "1", "--traversal-strategy", "0"])
+    f.write_text("<a> <p> <x> .\n<b> <p> <x> .\n")
+    for flag in ("--only-read", "--do-only-join"):
+        assert rdfind.main([str(f), "--sharded-ingest", flag, "--counters",
+                            "1", "--support", "1"]) == 0
+        err = capsys.readouterr().err
+        assert "input-triples: 2" in err
+        assert "cind-counter" not in err  # discovery never ran
 
 
 def test_rdfind_sharded_ingest_checkpoint_resume(tmp_path, capsys):
     """Second --sharded-ingest run resumes both the per-host ingest cache and
-    the discover checkpoint, with identical output."""
+    the discover checkpoint, with identical output — including the mined AR
+    table (non-scalar stats survive the checkpoint, so resume re-mines
+    nothing)."""
     f = tmp_path / "c.nt"
     f.write_text("".join(f"<s{i % 3}> <p> <o{i % 2}> .\n" for i in range(12)))
     args = [str(f), "--support", "2", "--sharded-ingest", "--counters", "1",
+            "--use-fis", "--use-ars",
             "--checkpoint-dir", str(tmp_path / "ck"),
+            "--ar-output", str(tmp_path / "{}.ars"),
             "--output", str(tmp_path / "{}.tsv")]
     assert rdfind.main([a.format("first") for a in args]) == 0
     first_err = capsys.readouterr().err
@@ -309,8 +317,11 @@ def test_rdfind_sharded_ingest_checkpoint_resume(tmp_path, capsys):
     second_err = capsys.readouterr().err
     assert "resumed-ingest: 1" in second_err
     assert "resumed-discover: 1" in second_err
+    assert "phase mine-ars" not in second_err  # rules rode the checkpoint
     assert ((tmp_path / "first.tsv").read_text()
             == (tmp_path / "second.tsv").read_text())
+    assert ((tmp_path / "first.ars").read_text()
+            == (tmp_path / "second.ars").read_text())
 
 
 def test_rdfind_sharded_ingest_use_ars(tmp_path):
