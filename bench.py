@@ -90,8 +90,8 @@ def _init_backend(retries: int = 2, delay_s: float = 5.0,
 # Public per-chip spec-sheet peaks (cloud.google.com/tpu docs): the roofline
 # denominators for the MFU report.
 TPU_PEAKS = {
-    "v5e": {"bf16_tflops": 197.0, "hbm_gbps": 819.0},
-    "v5p": {"bf16_tflops": 459.0, "hbm_gbps": 2765.0},
+    "v5e": {"bf16_tflops": 197.0, "int8_tops": 394.0, "hbm_gbps": 819.0},
+    "v5p": {"bf16_tflops": 459.0, "int8_tops": 918.0, "hbm_gbps": 2765.0},
     "v4": {"bf16_tflops": 275.0, "hbm_gbps": 1228.0},
 }
 
@@ -121,28 +121,43 @@ def _measure_mfu(stats: dict, backend: str) -> dict:
     dep_count = jnp.asarray(rng.integers(1, 50, c_pad, np.int32))
     cap_id = jnp.asarray(rng.integers(0, 1 << 20, c_pad, np.int32))
 
-    def sweep():
-        outs = [cooc.cooc_cind_tile(m, jnp.int32(lo), dep_count, cap_id,
-                                    cap_id, cap_id, jnp.int32(10), tile=tile)
-                for lo in range(0, c_pad, tile)]
-        jax.block_until_ready(outs)
+    def time_sweep(mat):
+        def sweep():
+            outs = [cooc.cooc_cind_tile(mat, jnp.int32(lo), dep_count, cap_id,
+                                        cap_id, cap_id, jnp.int32(10),
+                                        tile=tile)
+                    for lo in range(0, c_pad, tile)]
+            jax.block_until_ready(outs)
 
-    sweep()  # compile
-    reps = 3
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        sweep()
-    dt = (time.perf_counter() - t0) / reps
+        sweep()  # compile
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            sweep()
+        return (time.perf_counter() - t0) / reps
+
+    dt = time_sweep(m)
     flops = 2.0 * l_pad * c_pad * c_pad  # one full (c_pad x l_pad x c_pad) pass
     achieved = flops / dt
     out = {"l_pad": l_pad, "c_pad": c_pad, "tile": tile,
            "sweep_s": round(dt, 4), "achieved_tflops": round(achieved / 1e12, 3)}
+    try:
+        # Same sweep on int8 membership (the RDFIND_COOC_DTYPE=int8 path):
+        # measures whether the int8 MXU path beats bf16 at these shapes.
+        dt8 = time_sweep(m.astype(jnp.int8))
+        out["int8_achieved_tops"] = round(flops / dt8 / 1e12, 3)
+        out["int8_vs_bf16"] = round(dt / dt8, 3)
+    except Exception as e:  # int8 matmul unsupported on some backends
+        out["int8_error"] = f"{type(e).__name__}: {e}"
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     if backend == "tpu" and gen in TPU_PEAKS:
         peak = TPU_PEAKS[gen]["bf16_tflops"] * 1e12
         out["chip"] = gen
         out["peak_bf16_tflops"] = TPU_PEAKS[gen]["bf16_tflops"]
         out["mfu"] = round(achieved / peak, 4)
+        if "int8_achieved_tops" in out and "int8_tops" in TPU_PEAKS[gen]:
+            out["int8_mfu"] = round(
+                out["int8_achieved_tops"] / TPU_PEAKS[gen]["int8_tops"], 4)
     return out
 
 

@@ -132,9 +132,14 @@ def _stage_prepare(triples, n_valid, min_support, *, projections, use_fc_filter,
             cap_cols[0], cap_cols[1], cap_cols[2], num_caps)
 
 
-@functools.partial(jax.jit, static_argnames=("l_pad", "c_pad"))
-def _stage_membership(line_gid, cap_id, valid, min_support, *, l_pad, c_pad):
+@functools.partial(jax.jit,
+                   static_argnames=("l_pad", "c_pad", "membership_dtype"))
+def _stage_membership(line_gid, cap_id, valid, min_support, *, l_pad, c_pad,
+                      membership_dtype):
     """Membership matrix + the aggregates that fall out of it.
+
+    `membership_dtype` mirrors cooc.COOC_DTYPE into this jit's static key
+    (build_membership inlines here, so the outer cache must carry it).
 
     Returns (m, dep_count, lens): dep_count[c] = distinct join values
     containing capture c (column sums — exact in f32 below 2^24 lines);
@@ -142,11 +147,10 @@ def _stage_membership(line_gid, cap_id, valid, min_support, *, l_pad, c_pad):
     matching the chunked path's per-line pair accounting.
     """
     m = cooc.build_membership(line_gid, cap_id, valid, l_pad=l_pad, c_pad=c_pad)
-    dep_count = jnp.sum(m, axis=0, dtype=jnp.float32).astype(jnp.int32)
-    freq_mask = (dep_count >= min_support).astype(jnp.bfloat16)
-    lens = jax.lax.dot_general(
-        m, freq_mask, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(jnp.int32)
+    acc = jnp.int32 if m.dtype == jnp.int8 else jnp.float32
+    dep_count = jnp.sum(m, axis=0, dtype=acc).astype(jnp.int32)
+    freq_mask = (dep_count >= min_support).astype(m.dtype)
+    lens = cooc.cooc_dot(m, freq_mask, dims=((1,), (0,)))
     return m, dep_count, lens
 
 
@@ -155,9 +159,11 @@ def _stage_membership(line_gid, cap_id, valid, min_support, *, l_pad, c_pad):
 SINGLE_SHOT_C = 16384
 
 
-@functools.partial(jax.jit, static_argnames=("l_pad", "c_pad"))
+@functools.partial(jax.jit,
+                   static_argnames=("l_pad", "c_pad", "membership_dtype"))
 def _stage_dense_all(line_gid, cap_id, valid, min_support,
-                     cap_code, cap_v1, cap_v2, *, l_pad, c_pad):
+                     cap_code, cap_v1, cap_v2, *, l_pad, c_pad,
+                     membership_dtype):
     """Membership + full cooc + CIND test + bit-pack, fused in one dispatch.
 
     Fusing everything after candidate prep keeps the axon tunnel out of the
@@ -165,7 +171,8 @@ def _stage_dense_all(line_gid, cap_id, valid, min_support,
     lens) — per-dispatch latency was a third of the r2.5 wall clock.
     """
     m, dep_count, lens = _stage_membership(line_gid, cap_id, valid, min_support,
-                                           l_pad=l_pad, c_pad=c_pad)
+                                           l_pad=l_pad, c_pad=c_pad,
+                                           membership_dtype=membership_dtype)
     packed = cooc.cooc_cind_tile(
         m, jnp.int32(0), dep_count,
         _fit_device(cap_code, c_pad), _fit_device(cap_v1, c_pad),
@@ -356,7 +363,8 @@ def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
     if c_pad <= SINGLE_SHOT_C:
         packed, dep_count, lens = _stage_dense_all(
             line_gid, cap_id, cand_valid, jnp.int32(min_support),
-            cap_code, cap_v1, cap_v2, l_pad=l_pad, c_pad=c_pad)
+            cap_code, cap_v1, cap_v2, l_pad=l_pad, c_pad=c_pad,
+            membership_dtype=cooc.COOC_DTYPE)
         # One bundled pull: packed CIND bits + per-line lengths + supports +
         # the capture table columns.
         (packed_h, lens_h, dep_count_h, code_h, v1_h, v2_h) = jax.device_get(
@@ -370,6 +378,7 @@ def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
     else:
         m, dep_count, lens = _stage_membership(
             line_gid, cap_id, cand_valid, jnp.int32(min_support),
+            membership_dtype=cooc.COOC_DTYPE,
             l_pad=l_pad, c_pad=c_pad)
         lens_h = np.asarray(jax.lax.slice(lens, (0,), (n_lines,)), np.int64)
         dep_id, ref_id, support = cooc.discover_pairs_dense(

@@ -145,9 +145,7 @@ def _stage_tile_counts(m, dep_lo, d_local, r_idx, valid, *, tile: int):
     gathered on device — only the per-pair counts travel back to the host.
     """
     m_tile = jax.lax.dynamic_slice(m, (0, dep_lo), (m.shape[0], tile))
-    cooc = jax.lax.dot_general(
-        m_tile, m, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(jnp.int32)
+    cooc = cooc_ops.cooc_dot(m_tile, m)
     return jnp.where(valid, cooc[d_local, r_idx], 0)
 
 

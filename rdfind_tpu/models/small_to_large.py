@@ -371,9 +371,7 @@ def _half_approx_cooc_11(line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats,
 @jax.jit
 def _stage_cooc_full(m):
     """(c_pad, c_pad) int32 co-occurrence counts from the membership matrix."""
-    return jax.lax.dot_general(
-        m, m, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(jnp.int32)
+    return cooc_ops.cooc_dot(m, m)
 
 
 class _DenseCooc:
@@ -408,7 +406,7 @@ def _prepare_dense(padded, n, min_support, projections, use_fc_filter, use_ars,
     l_pad, c_pad, _ = plan
     m, dep_count_d, lens = allatonce._stage_membership(
         line_gid, cap_id, cand_valid, jnp.int32(min_support),
-        l_pad=l_pad, c_pad=c_pad)
+        l_pad=l_pad, c_pad=c_pad, membership_dtype=cooc_ops.COOC_DTYPE)
     cooc_m = _stage_cooc_full(m)
     (cap_code, cap_v1, cap_v2, dep_count, lens_h) = jax.device_get(
         (cap_code_d[:num_caps], cap_v1_d[:num_caps], cap_v2_d[:num_caps],
@@ -537,9 +535,8 @@ def _lat22(rel_all, cind12, m_mat, cooc_m, support, ms, bin_ids, s1, s2,
 def _union_line_counts(m_mat, union_mask):
     """Per-line count of union-flagged captures — the chunked backend's pair
     accounting (stat = sum u*(u-1)), kept for backend comparability."""
-    return jax.lax.dot_general(
-        m_mat, union_mask.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(jnp.int32)
+    return cooc_ops.cooc_dot(m_mat, union_mask.astype(m_mat.dtype),
+                             dims=((1,), (0,)))
 
 
 def _bits_pairs(packed_h, rows, cols):

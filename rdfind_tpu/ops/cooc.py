@@ -6,8 +6,10 @@ as a CIND evidence and intersects evidence sets per dependent
 The count reformulation used across this repo tests `cooc(d, r) == support(d)`
 instead.  This module computes the *entire* cooc matrix as one blocked matmul:
 
-    M    : (lines x captures) 0/1 membership, bf16 in HBM
-    cooc : M^T M, f32 accumulation on the MXU (exact while lines < 2^24)
+    M    : (lines x captures) 0/1 membership in HBM — bf16 by default,
+           int8 via RDFIND_COOC_DTYPE=int8
+    cooc : M^T M on the MXU — f32 accumulation for bf16 (exact while
+           lines < 2^24), int32 for int8 (exact to int32 counts)
 
 which replaces the sort-dominated chunked pair pipeline (r2 bench: lexsort over
 every 4M-pair chunk + a host sync per chunk left the MXU idle and lost 13x to
@@ -35,11 +37,30 @@ from . import segments
 
 # Dep-tile rows per cooc block: (DT x C_pad) f32 tile = 16 MB per 1k captures.
 DEFAULT_TILE = 4096
-# Dense membership budget: M is (L_pad x C_pad) bf16 in HBM.  v5e has 16 GB;
-# leave room for the cooc tile, capture tables, and XLA scratch.
+# Dense membership budget: M is (L_pad x C_pad) x elem_bytes in HBM (2 for
+# bf16, 1 for int8).  v5e has 16 GB; leave room for the cooc tile, capture
+# tables, and XLA scratch.
 DENSE_M_BUDGET_BYTES = int(os.environ.get("RDFIND_DENSE_M_BUDGET", 6 << 30))
-# f32 accumulation is exact up to 2^24; more lines than that must fall back.
+# bf16 mode's f32 accumulation is exact up to 2^24 lines; past that the bf16
+# dense plan must fall back (int8 mode accumulates in int32 — no such cap).
 MAX_LINES_EXACT_F32 = 1 << 24
+
+# Membership element type for the cooc matmuls.  bf16 rides the MXU's native
+# path; int8 ("RDFIND_COOC_DTYPE=int8") halves membership HBM, doubles the
+# v5e's MXU peak (int8 ~2x bf16 FLOP/s), and its int32 accumulation is exact
+# far past f32's 2^24-line cap — kept opt-in until measured faster on-chip.
+COOC_DTYPE = os.environ.get("RDFIND_COOC_DTYPE", "bf16")
+if COOC_DTYPE not in ("bf16", "int8"):
+    raise ValueError(f"RDFIND_COOC_DTYPE must be bf16 or int8, "
+                     f"got {COOC_DTYPE!r}")
+
+
+def cooc_dot(a, b, dims=((0,), (0,))):
+    """Exact integer counts from a 0/1-matrix product: accumulate in the
+    dtype-matched exact accumulator (f32 for bf16, int32 for int8)."""
+    acc = jnp.int32 if a.dtype == jnp.int8 else jnp.float32
+    return jax.lax.dot_general(
+        a, b, (dims, ((), ())), preferred_element_type=acc).astype(jnp.int32)
 
 
 def pack_bool(x):
@@ -63,8 +84,10 @@ def dense_plan(n_lines: int, num_caps: int, tile: int = DEFAULT_TILE):
     Returns (l_pad, c_pad, tile) with c_pad a multiple of 128 (MXU lanes and
     32-bit packing) and l_pad a multiple of 8 (f32 sublanes).
     """
-    if n_lines == 0 or num_caps == 0 or n_lines >= MAX_LINES_EXACT_F32:
+    if n_lines == 0 or num_caps == 0:
         return None
+    if COOC_DTYPE != "int8" and n_lines >= MAX_LINES_EXACT_F32:
+        return None  # int8 accumulates in int32: exact to 2^31 counts
     # Power-of-two buckets so compiled programs are reused across datasets
     # (the repo-wide capacity policy, segments.pow2_capacity).  c_pad a pow2
     # >= 128 is automatically a multiple of the (pow2) tile, which keeps every
@@ -72,18 +95,31 @@ def dense_plan(n_lines: int, num_caps: int, tile: int = DEFAULT_TILE):
     l_pad = max(8, segments.pow2_capacity(n_lines))
     c_pad = max(128, segments.pow2_capacity(num_caps))
     tile = min(tile, c_pad)
-    if l_pad * c_pad * 2 > DENSE_M_BUDGET_BYTES:
+    elem_bytes = 1 if COOC_DTYPE == "int8" else 2
+    if l_pad * c_pad * elem_bytes > DENSE_M_BUDGET_BYTES:
         return None
     return l_pad, c_pad, tile
 
 
-@functools.partial(jax.jit, static_argnames=("l_pad", "c_pad"))
-def build_membership(line_gid, line_cap, valid, *, l_pad: int, c_pad: int):
-    """Scatter (line, capture) rows into the (l_pad, c_pad) 0/1 bf16 matrix."""
+@functools.partial(jax.jit, static_argnames=("l_pad", "c_pad", "dtype"))
+def _build_membership(line_gid, line_cap, valid, *, l_pad: int, c_pad: int,
+                      dtype: str):
+    dt = jnp.int8 if dtype == "int8" else jnp.bfloat16
     li = jnp.where(valid, line_gid, l_pad)
     ci = jnp.where(valid, line_cap, c_pad)
-    m = jnp.zeros((l_pad, c_pad), jnp.bfloat16)
-    return m.at[li, ci].set(jnp.bfloat16(1), mode="drop")
+    m = jnp.zeros((l_pad, c_pad), dt)
+    return m.at[li, ci].set(jnp.asarray(1, dt), mode="drop")
+
+
+def build_membership(line_gid, line_cap, valid, *, l_pad: int, c_pad: int):
+    """Scatter (line, capture) rows into the (l_pad, c_pad) 0/1 matrix.
+
+    The element type (bf16 default, int8 via COOC_DTYPE) is a STATIC jit key:
+    the inputs' avals don't carry it, so it must key the cache explicitly or
+    a dtype flip would silently reuse the other mode's compiled program.
+    Downstream consumers take `m` itself, whose aval re-keys them."""
+    return _build_membership(line_gid, line_cap, valid, l_pad=l_pad,
+                             c_pad=c_pad, dtype=COOC_DTYPE)
 
 
 @functools.partial(jax.jit, static_argnames=("tile",))
@@ -102,9 +138,7 @@ def cooc_cind_tile(m, dep_lo, dep_count, cap_code, cap_v1, cap_v2,
     """
     c_pad = m.shape[1]
     m_tile = jax.lax.dynamic_slice(m, (0, dep_lo), (m.shape[0], tile))
-    cooc = jax.lax.dot_general(
-        m_tile, m, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(jnp.int32)
+    cooc = cooc_dot(m_tile, m)
 
     d_idx = dep_lo + jnp.arange(tile, dtype=jnp.int32)
     d_safe = jnp.clip(d_idx, 0, c_pad - 1)
