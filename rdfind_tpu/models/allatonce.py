@@ -138,15 +138,17 @@ def _stage_membership(line_gid, cap_id, valid, min_support, *, l_pad, c_pad,
                       membership_dtype):
     """Membership matrix + the aggregates that fall out of it.
 
-    `membership_dtype` mirrors cooc.COOC_DTYPE into this jit's static key
-    (build_membership inlines here, so the outer cache must carry it).
+    `membership_dtype` (callers pass cooc.COOC_DTYPE) is load-bearing: it
+    both keys this jit's cache and selects the dtype build_membership
+    actually uses (inlined here, the inputs' avals don't carry it).
 
     Returns (m, dep_count, lens): dep_count[c] = distinct join values
     containing capture c (column sums — exact in f32 below 2^24 lines);
     lens[l] = frequent captures in line l (matvec against the frequency mask),
     matching the chunked path's per-line pair accounting.
     """
-    m = cooc.build_membership(line_gid, cap_id, valid, l_pad=l_pad, c_pad=c_pad)
+    m = cooc.build_membership(line_gid, cap_id, valid, l_pad=l_pad,
+                              c_pad=c_pad, dtype=membership_dtype)
     acc = jnp.int32 if m.dtype == jnp.int8 else jnp.float32
     dep_count = jnp.sum(m, axis=0, dtype=acc).astype(jnp.int32)
     freq_mask = (dep_count >= min_support).astype(m.dtype)

@@ -111,15 +111,18 @@ def _build_membership(line_gid, line_cap, valid, *, l_pad: int, c_pad: int,
     return m.at[li, ci].set(jnp.asarray(1, dt), mode="drop")
 
 
-def build_membership(line_gid, line_cap, valid, *, l_pad: int, c_pad: int):
+def build_membership(line_gid, line_cap, valid, *, l_pad: int, c_pad: int,
+                     dtype: str | None = None):
     """Scatter (line, capture) rows into the (l_pad, c_pad) 0/1 matrix.
 
-    The element type (bf16 default, int8 via COOC_DTYPE) is a STATIC jit key:
-    the inputs' avals don't carry it, so it must key the cache explicitly or
-    a dtype flip would silently reuse the other mode's compiled program.
-    Downstream consumers take `m` itself, whose aval re-keys them."""
+    The element type (bf16 default, int8 via COOC_DTYPE; `dtype` overrides)
+    is a STATIC jit key: the inputs' avals don't carry it, so it must key the
+    cache explicitly or a dtype flip would silently reuse the other mode's
+    compiled program.  Downstream consumers take `m` itself, whose aval
+    re-keys them."""
     return _build_membership(line_gid, line_cap, valid, l_pad=l_pad,
-                             c_pad=c_pad, dtype=COOC_DTYPE)
+                             c_pad=c_pad,
+                             dtype=COOC_DTYPE if dtype is None else dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("tile",))

@@ -26,17 +26,10 @@ def _free_port():
 
 def _run_workers(strategy: str):
     port = _free_port()
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    procs = [subprocess.Popen(
-        [sys.executable, os.path.join(_REPO, "tests", "multihost_worker.py"),
-         str(pid), "2", str(port), strategy],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for pid in range(2)]
-    with ThreadPoolExecutor(len(procs)) as ex:
-        outs = list(ex.map(lambda p: p.communicate(timeout=540), procs))
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+    worker = os.path.join(_REPO, "tests", "multihost_worker.py")
+    outs = _run_procs(
+        [[sys.executable, worker, str(pid), "2", str(port), strategy]
+         for pid in range(2)], _cpu_env())
     rows_line = [l for l in outs[0][0].splitlines() if l.startswith("ROWS ")]
     assert rows_line, outs[0][0]
     return json.loads(rows_line[0][5:])
@@ -75,20 +68,46 @@ NT_SHARDS = [
 ]
 
 
-def _run_ingest_workers(paths, mode: str, strategy: str = "0"):
-    port = _free_port()
+def _cpu_env(fake_devices: int | None = None):
+    """Worker env: strip the conftest's backend pins; optionally re-pin CPU
+    with a fake-device mesh (the CLI workers read these)."""
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    procs = [subprocess.Popen(
-        [sys.executable,
-         os.path.join(_REPO, "tests", "multihost_ingest_worker.py"),
-         str(pid), "2", str(port), ",".join(paths), mode, strategy],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for pid in range(2)]
-    with ThreadPoolExecutor(len(procs)) as ex:
-        outs = list(ex.map(lambda p: p.communicate(timeout=540), procs))
+    if fake_devices is not None:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={fake_devices}")
+    return env
+
+
+def _run_procs(cmds, env, timeout=540):
+    """Spawn one process per command, gather (stdout, stderr), assert rc=0.
+
+    On a communicate() timeout every peer is killed before the raise — a hung
+    coordinated worker must not leak and wedge later tests."""
+    procs = [subprocess.Popen(c, cwd=_REPO, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=env)
+             for c in cmds]
+    try:
+        with ThreadPoolExecutor(len(procs)) as ex:
+            outs = list(ex.map(lambda p: p.communicate(timeout=timeout),
+                               procs))
+    except Exception:
+        for p in procs:
+            p.kill()
+        raise
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+    return outs
+
+
+def _run_ingest_workers(paths, mode: str, strategy: str = "0"):
+    port = _free_port()
+    worker = os.path.join(_REPO, "tests", "multihost_ingest_worker.py")
+    outs = _run_procs(
+        [[sys.executable, worker, str(pid), "2", str(port), ",".join(paths),
+          mode, strategy] for pid in range(2)],
+        _cpu_env())
     lines = dict(l.split(" ", 1) for l in outs[0][0].splitlines()
                  if l.startswith(("TOTAL", "CINDS", "DICT")))
     dicts = [json.loads(l.split(" ", 1)[1]) for out, _ in outs
@@ -200,20 +219,12 @@ def test_two_process_sharded_ingest_fcs_and_asciify(tmp_path):
     flags = ["--support", "2", "--find-only-fcs", "2", "--asciify-triples",
              "--distinct-triples", "--counters", "1"]
     port = _free_port()
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    procs = [subprocess.Popen(
-        [sys.executable, "-m", "rdfind_tpu.programs.rdfind", *paths, *flags,
-         "--sharded-ingest", "--coordinator", f"127.0.0.1:{port}",
-         "--num-hosts", "2", "--host-index", str(pid)],
-        cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        text=True, env=env) for pid in range(2)]
-    with ThreadPoolExecutor(len(procs)) as ex:
-        outs = list(ex.map(lambda p: p.communicate(timeout=540), procs))
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+    env = _cpu_env(fake_devices=4)
+    outs = _run_procs(
+        [[sys.executable, "-m", "rdfind_tpu.programs.rdfind", *paths, *flags,
+          "--sharded-ingest", "--coordinator", f"127.0.0.1:{port}",
+          "--num-hosts", "2", "--host-index", str(pid)]
+         for pid in range(2)], env)
     got = counters_of(outs[0][1])
 
     r = subprocess.run(
@@ -224,3 +235,41 @@ def test_two_process_sharded_ingest_fcs_and_asciify(tmp_path):
     assert "frequent-single-conditions" in want
     assert "distinct-triples" in want
     assert got == want
+
+
+def test_two_process_sharded_ingest_ars(tmp_path):
+    """--use-ars + --ar-output under --sharded-ingest: rules mined with count
+    exchanges across REAL process boundaries equal the replicated host
+    miner's, and the AR-filtered CIND output matches."""
+    paths = []
+    for i, content in enumerate(NT_SHARDS):
+        p = tmp_path / f"shard{i}.nt"
+        p.write_text(content + "<ruler> <is> <thing> .\n")  # cross-shard rule
+        paths.append(str(p))
+
+    def run(tag, extra):
+        out = tmp_path / f"{tag}.tsv"
+        ars = tmp_path / f"{tag}.ars"
+        flags = [*paths, "--support", "2", "--use-fis", "--use-ars",
+                 "--output", str(out), "--ar-output", str(ars)]
+        env = _cpu_env(fake_devices=4)
+        if extra:
+            port = _free_port()
+            _run_procs(
+                [[sys.executable, "-m", "rdfind_tpu.programs.rdfind", *flags,
+                  *extra, "--coordinator", f"127.0.0.1:{port}",
+                  "--num-hosts", "2", "--host-index", str(pid)]
+                 for pid in range(2)], env)
+        else:
+            r = subprocess.run(
+                [sys.executable, "-m", "rdfind_tpu.programs.rdfind", *flags],
+                cwd=_REPO, capture_output=True, text=True, env=env,
+                timeout=540)
+            assert r.returncode == 0, r.stderr[-2000:]
+        return sorted(out.read_text().splitlines()), \
+            sorted(ars.read_text().splitlines())
+
+    got_cinds, got_ars = run("sharded", ["--sharded-ingest"])
+    want_cinds, want_ars = run("replicated", None)
+    assert got_ars == want_ars and len(want_ars) > 0
+    assert got_cinds == want_cinds
