@@ -179,7 +179,24 @@ def _stage_dense_all(line_gid, cap_id, valid, min_support,
         m, jnp.int32(0), dep_count,
         _fit_device(cap_code, c_pad), _fit_device(cap_v1, c_pad),
         _fit_device(cap_v2, c_pad), min_support, tile=c_pad)
-    return packed, dep_count, lens
+    # int32 is exact: the bit matrix has at most SINGLE_SHOT_C^2 = 2^28 bits.
+    n_cinds = jax.lax.population_count(packed).sum(dtype=jnp.int32)
+    return packed, dep_count, lens, n_cinds
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _stage_extract_pairs(packed, *, cap: int):
+    """Device-side (dep, ref) extraction from the packed CIND bits.
+
+    Replaces the host unpackbits + np.nonzero over the full c_pad^2 bit
+    matrix: the host pulls only `cap` index pairs (cap = pow2 of the exact
+    popcount from _stage_dense_all) instead of c_pad^2/8 bytes of bits —
+    the pull and the host scan were the dominant non-matmul cost of the
+    single-shot path at headline shapes."""
+    from ..ops import sketch
+
+    d, ref = jnp.nonzero(sketch.unpack_planes(packed), size=cap, fill_value=0)
+    return d.astype(jnp.int32), ref.astype(jnp.int32)
 
 
 def _fit_device(arr, length: int):
@@ -363,19 +380,26 @@ def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
     l_pad, c_pad, tile = plan
 
     if c_pad <= SINGLE_SHOT_C:
-        packed, dep_count, lens = _stage_dense_all(
+        packed, dep_count, lens, n_bits = _stage_dense_all(
             line_gid, cap_id, cand_valid, jnp.int32(min_support),
             cap_code, cap_v1, cap_v2, l_pad=l_pad, c_pad=c_pad,
             membership_dtype=cooc.COOC_DTYPE)
-        # One bundled pull: packed CIND bits + per-line lengths + supports +
-        # the capture table columns.
-        (packed_h, lens_h, dep_count_h, code_h, v1_h, v2_h) = jax.device_get(
-            (packed, jax.lax.slice(lens, (0,), (n_lines,)),
-             jax.lax.slice(dep_count, (0,), (num_caps,)),
-             cap_code[:num_caps], cap_v1[:num_caps], cap_v2[:num_caps]))
+        # Two-dispatch pair extraction: pull the exact CIND count (8 bytes),
+        # then pull only that many (dep, ref) indices — never the bit matrix.
+        n_cinds = int(jax.device_get(n_bits))
+        pulls = [jax.lax.slice(lens, (0,), (n_lines,)),
+                 jax.lax.slice(dep_count, (0,), (num_caps,)),
+                 cap_code[:num_caps], cap_v1[:num_caps], cap_v2[:num_caps]]
+        if n_cinds:
+            pulls += _stage_extract_pairs(
+                packed, cap=segments.pow2_capacity(n_cinds))
+        else:
+            pulls += [np.zeros(0, np.int32)] * 2
+        (lens_h, dep_count_h, code_h, v1_h, v2_h, dep_id, ref_id) = \
+            jax.device_get(pulls)
         lens_h = lens_h.astype(np.int64)
-        bits = cooc.unpack_cind_bits(packed_h, c_pad)
-        dep_id, ref_id = np.nonzero(bits[:num_caps, :num_caps])
+        dep_id = dep_id[:n_cinds].astype(np.int64)
+        ref_id = ref_id[:n_cinds].astype(np.int64)
         support = dep_count_h[dep_id]
     else:
         m, dep_count, lens = _stage_membership(
