@@ -184,19 +184,6 @@ def _stage_dense_all(line_gid, cap_id, valid, min_support,
     return packed, dep_count, lens, n_cinds
 
 
-@functools.partial(jax.jit, static_argnames=("cap",))
-def _stage_extract_pairs(packed, *, cap: int):
-    """Device-side (dep, ref) extraction from the packed CIND bits.
-
-    Replaces the host unpackbits + np.nonzero over the full c_pad^2 bit
-    matrix: the host pulls only `cap` index pairs (cap = pow2 of the exact
-    popcount from _stage_dense_all) instead of c_pad^2/8 bytes of bits —
-    the pull and the host scan were the dominant non-matmul cost of the
-    single-shot path at headline shapes."""
-    from ..ops import sketch
-
-    d, ref = jnp.nonzero(sketch.unpack_planes(packed), size=cap, fill_value=0)
-    return d.astype(jnp.int32), ref.astype(jnp.int32)
 
 
 def _fit_device(arr, length: int):
@@ -384,15 +371,18 @@ def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
             line_gid, cap_id, cand_valid, jnp.int32(min_support),
             cap_code, cap_v1, cap_v2, l_pad=l_pad, c_pad=c_pad,
             membership_dtype=cooc.COOC_DTYPE)
-        # Two-dispatch pair extraction: pull the exact CIND count (8 bytes),
-        # then pull only that many (dep, ref) indices — never the bit matrix.
+        # Two-dispatch pair extraction: pull the exact CIND count (8 bytes,
+        # fused into the main dispatch), then pull only that many (dep, ref)
+        # indices — never the bit matrix (cooc.extract_packed's rationale).
         n_cinds = int(jax.device_get(n_bits))
         pulls = [jax.lax.slice(lens, (0,), (n_lines,)),
                  jax.lax.slice(dep_count, (0,), (num_caps,)),
                  cap_code[:num_caps], cap_v1[:num_caps], cap_v2[:num_caps]]
         if n_cinds:
-            pulls += _stage_extract_pairs(
-                packed, cap=segments.pow2_capacity(n_cinds))
+            pulls += cooc.packed_nonzero(
+                packed, jnp.int32(packed.shape[0]),
+                jnp.int32(packed.shape[1] * 32),
+                cap=segments.pow2_capacity(n_cinds))
         else:
             pulls += [np.zeros(0, np.int32)] * 2
         (lens_h, dep_count_h, code_h, v1_h, v2_h, dep_id, ref_id) = \

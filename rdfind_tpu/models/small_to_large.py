@@ -539,13 +539,6 @@ def _union_line_counts(m_mat, union_mask):
                              dims=((1,), (0,)))
 
 
-def _bits_pairs(packed_h, rows, cols):
-    """Host decode of a packed relation: (row_idx, col_idx) of set bits."""
-    bits = cooc_ops.unpack_cind_bits(packed_h, packed_h.shape[1] * 32)
-    d, r = np.nonzero(bits[:rows, :cols])
-    return d.astype(np.int64), r.astype(np.int64)
-
-
 def _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
                        min_support, use_ars, rules, clean_implied,
                        stats) -> CindTable:
@@ -581,8 +574,8 @@ def _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
         cooc_m, support_d, jnp.asarray(u_freq), ms)
     if stats is not None:
         stat_add("pairs_11", _union_line_counts(m_mat, jnp.asarray(u_freq)))
-    k_packed_h, n_prop_h = jax.device_get((k_packed, n_prop))
-    cind11_d, cind11_r = _bits_pairs(k_packed_h, num_caps, num_caps)
+    n_prop_h = jax.device_get(n_prop)
+    cind11_d, cind11_r = cooc_ops.extract_packed(k_packed, num_caps, num_caps)
     if use_ars:
         keep = ~frequency.ar_implied_pair_mask(
             cap_code[cind11_d], cap_code[cind11_r],
@@ -647,13 +640,38 @@ def _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
         sub_ok, code_b, v1_b, v2_b, freq_d)
     stat_add("pairs_22", u22, n_cand22)
 
-    (c12_h, c21_h, c22_h, n_inf_h) = jax.device_get(
-        (cind12_packed, cind21_packed, cind22_packed, n_inf))
-    d12, r12b = _bits_pairs(c12_h, num_caps, nb)
+    # Batched two-phase decode of the three binary relations: one pull of all
+    # counts (+ n_inf), then one pull of all sized nonzeros — two round trips
+    # total instead of two per relation (extract_packed's single-caller API).
+    relations = [(cind12_packed, num_caps, nb), (cind21_packed, nb, num_caps),
+                 (cind22_packed, nb, nb)]
+    oversized = any(p.shape[0] * p.shape[1] * 32
+                    > cooc_ops.EXTRACT_DEVICE_ELEMS for p, _, _ in relations)
+    if oversized:
+        n_inf_h = jax.device_get(n_inf)
+        pairs_brc = [cooc_ops.extract_packed(p, r_, c_)
+                     for p, r_, c_ in relations]
+    else:
+        *counts, n_inf_h = jax.device_get(
+            [cooc_ops.packed_count(p, jnp.int32(r_), jnp.int32(c_))
+             for p, r_, c_ in relations] + [n_inf])
+        pulls = [cooc_ops.packed_nonzero(
+                     p, jnp.int32(r_), jnp.int32(c_),
+                     cap=segments.pow2_capacity(int(n)))
+                 for n, (p, r_, c_) in zip(counts, relations) if int(n)]
+        flat = iter(jax.device_get([x for dr in pulls for x in dr]))
+        pairs_brc = []
+        for n in (int(c) for c in counts):
+            if n:
+                d_, r_ = next(flat), next(flat)
+                pairs_brc.append((d_[:n].astype(np.int64),
+                                  r_[:n].astype(np.int64)))
+            else:
+                z = np.zeros(0, np.int64)
+                pairs_brc.append((z, z))
+    (d12, r12b), (d21b, r21), (d22b, r22b) = pairs_brc
     r12 = bin_ids_h[r12b]
-    d21b, r21 = _bits_pairs(c21_h, nb, num_caps)
     d21 = bin_ids_h[d21b]
-    d22b, r22b = _bits_pairs(c22_h, nb, nb)
     d22, r22 = bin_ids_h[d22b], bin_ids_h[r22b]
 
     if stats is not None:

@@ -159,6 +159,69 @@ def cooc_cind_tile(m, dep_lo, dep_count, cap_code, cap_v1, cap_v2,
     return pack_bool(is_cind & ~implied)
 
 
+def _inbounds(packed, rows, cols):
+    """Zero out words outside the [0, rows) x [0, cols) bit region.
+
+    rows/cols are TRACED operands (not static jit keys): the compiled
+    programs key only on the pow2-bucketed packed shape, preserving the
+    repo's program-reuse policy across lattice levels and datasets."""
+    word_idx = jnp.arange(packed.shape[1], dtype=jnp.int32)
+    partial = jnp.clip(cols - word_idx * 32, 0, 32)
+    col_mask = jnp.where(partial >= 32, jnp.uint32(0xFFFFFFFF),
+                         (jnp.uint32(1) << partial.astype(jnp.uint32))
+                         - jnp.uint32(1))
+    row_ok = jnp.arange(packed.shape[0], dtype=jnp.int32) < rows
+    return jnp.where(row_ok[:, None], packed & col_mask[None, :], 0)
+
+
+@jax.jit
+def packed_count(packed, rows, cols):
+    """Set bits in the in-bounds region; int32 is exact under the
+    EXTRACT_DEVICE_ELEMS <= 2^28-bit gate callers apply."""
+    return jax.lax.population_count(_inbounds(packed, rows, cols)).sum(
+        dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def packed_nonzero(packed, rows, cols, *, cap: int):
+    """(row, col) indices of the first `cap` in-bounds set bits (row-major)."""
+    from . import sketch
+
+    d, r = jnp.nonzero(sketch.unpack_planes(_inbounds(packed, rows, cols)),
+                       size=cap, fill_value=0)
+    return d.astype(jnp.int32), r.astype(jnp.int32)
+
+
+# Device extraction materializes the unpacked relation plus nonzero's scan
+# intermediates; past this element count the HBM cost exceeds the transfer
+# saving and extract_packed decodes on the host instead (which uses no device
+# memory at all).  2^28 bits also keeps packed_count's int32 sum exact.
+EXTRACT_DEVICE_ELEMS = 1 << 28
+
+
+def extract_packed(packed, rows: int, cols: int):
+    """Decode a packed bool relation -> host (row, col) int64 index arrays.
+
+    Small enough relations decode on device — an exact popcount dispatch,
+    then a sized nonzero — so the host pulls one scalar plus exactly the
+    set-bit index pairs, never the bit matrix itself (the multi-MB pull +
+    host unpackbits scan dominated the lattice's non-matmul wall clock over
+    the tunnel).  Oversized relations fall back to the zero-HBM host decode."""
+    if packed.shape[0] * packed.shape[1] * 32 > EXTRACT_DEVICE_ELEMS:
+        bits = unpack_cind_bits(np.asarray(packed), packed.shape[1] * 32)
+        d, r = np.nonzero(bits[:rows, :cols])
+        return d.astype(np.int64), r.astype(np.int64)
+    n = int(np.asarray(packed_count(packed, jnp.int32(rows),
+                                    jnp.int32(cols))))
+    if n == 0:
+        z = np.zeros(0, np.int64)
+        return z, z
+    d, r = jax.device_get(packed_nonzero(
+        packed, jnp.int32(rows), jnp.int32(cols),
+        cap=segments.pow2_capacity(n)))
+    return d[:n].astype(np.int64), r[:n].astype(np.int64)
+
+
 def unpack_cind_bits(packed: np.ndarray, c_pad: int) -> np.ndarray:
     """(tile, c_pad//32) uint32 -> (tile, c_pad) 0/1 uint8 on host."""
     return np.unpackbits(
