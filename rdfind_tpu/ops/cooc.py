@@ -233,8 +233,11 @@ def discover_pairs_dense(m, dep_count, cap_code, cap_v1, cap_v2, min_support,
                          num_caps: int, tile: int):
     """Run the tiled cooc pass; return (dep_id, ref_id, support) numpy arrays.
 
-    m: (l_pad, c_pad) device membership matrix.  Host loops over dep tiles,
-    pulls each packed block, and decodes CIND positions.
+    m: (l_pad, c_pad) device membership matrix.  The host loops over dep
+    tiles dispatching the packed CIND blocks, then decodes them on device:
+    one batched pull of all tile popcounts, one batched pull of the sized
+    nonzeros — only the set-bit index pairs ever reach the host (same
+    two-phase decode as extract_packed, batched across tiles).
     """
     c_pad = m.shape[1]
     dep_count_d = jnp.asarray(dep_count, jnp.int32)
@@ -243,15 +246,40 @@ def discover_pairs_dense(m, dep_count, cap_code, cap_v1, cap_v2, min_support,
     v2_d = jnp.asarray(cap_v2, jnp.int32)
     ms = jnp.int32(min_support)
 
+    # Tiles decode in bounded batches: each batch pins at most
+    # EXTRACT_DEVICE_ELEMS packed bits on device (plus its sized-nonzero
+    # outputs) and costs two round trips — counts, then index pairs — so
+    # decode residency stays bounded while round trips stay
+    # O(total_bits / EXTRACT_DEVICE_ELEMS).  An oversized single tile makes
+    # batch=1 and extract_packed itself takes its zero-HBM host path.
+    batch = max(1, EXTRACT_DEVICE_ELEMS // (tile * c_pad))
+    los = list(range(0, num_caps, tile))
     deps, refs = [], []
-    for lo in range(0, num_caps, tile):
-        packed = cooc_cind_tile(m, jnp.int32(lo), dep_count_d, code_d, v1_d,
-                                v2_d, ms, tile=tile)
-        bits = unpack_cind_bits(np.asarray(packed), c_pad)
-        d_off, r = np.nonzero(bits)
-        keep = (d_off + lo < num_caps) & (r < num_caps)
-        deps.append((d_off[keep] + lo).astype(np.int64))
-        refs.append(r[keep].astype(np.int64))
+    for i in range(0, len(los), batch):
+        tiles = [(lo, cooc_cind_tile(m, jnp.int32(lo), dep_count_d, code_d,
+                                     v1_d, v2_d, ms, tile=tile))
+                 for lo in los[i:i + batch]]
+        if len(tiles) == 1:
+            lo, packed = tiles[0]
+            d_off, r = extract_packed(packed, min(num_caps - lo, tile),
+                                      num_caps)
+            deps.append(d_off + lo)
+            refs.append(r)
+            continue
+        counts = jax.device_get(
+            [packed_count(p, jnp.int32(min(num_caps - lo, tile)),
+                          jnp.int32(num_caps)) for lo, p in tiles])
+        pulls = [packed_nonzero(p, jnp.int32(min(num_caps - lo, tile)),
+                                jnp.int32(num_caps),
+                                cap=segments.pow2_capacity(int(n)))
+                 for n, (lo, p) in zip(counts, tiles) if int(n)]
+        flat = iter(jax.device_get([x for dr in pulls for x in dr]))
+        for n, (lo, _) in zip((int(c) for c in counts), tiles):
+            if not n:
+                continue
+            d_off, r = next(flat), next(flat)
+            deps.append(d_off[:n].astype(np.int64) + lo)
+            refs.append(r[:n].astype(np.int64))
     dep_id = np.concatenate(deps) if deps else np.zeros(0, np.int64)
     ref_id = np.concatenate(refs) if refs else np.zeros(0, np.int64)
     support = np.asarray(dep_count)[dep_id] if dep_id.size else np.zeros(0, np.int64)

@@ -189,3 +189,38 @@ def test_engine_int8_membership_matches(monkeypatch):
     assert small_to_large.discover(triples, 2).to_rows() == want_s2l
     assert approximate.discover(
         triples, 2, pair_backend="matmul").to_rows() == want
+
+
+def test_discover_pairs_dense_tiled(monkeypatch):
+    """The tiled dense sweep (the c_pad > SINGLE_SHOT_C fallback) against a
+    numpy oracle, on both decode branches (batched device nonzero and the
+    oversized host fallback)."""
+    import jax.numpy as jnp
+
+    from rdfind_tpu.ops import cooc
+
+    rng = np.random.default_rng(3)
+    n_lines, num_caps, min_support = 300, 200, 2
+    l_pad, c_pad = 512, 256
+    member = np.zeros((l_pad, c_pad), np.float32)
+    member[:n_lines, :num_caps] = rng.random((n_lines, num_caps)) < 0.05
+    m = jnp.asarray(member, jnp.bfloat16)
+    dep_count = member.sum(axis=0).astype(np.int64)
+    # Distinct (code, v1, v2) per capture id; codes chosen non-implying.
+    cap_code = np.full(c_pad, 12, np.int64)  # s[p=..] style
+    cap_v1 = np.arange(c_pad, dtype=np.int64)
+    cap_v2 = np.full(c_pad, -1, np.int64)
+
+    cooc_m = member.T @ member
+    want = {(d, r) for d, r in zip(*np.nonzero(
+        (cooc_m == dep_count[:, None]) & (dep_count[:, None] >= min_support)
+        & ~np.eye(c_pad, dtype=bool)))
+        if d < num_caps and r < num_caps}
+
+    for elems in (1 << 28, 1):  # device decode, then forced host fallback
+        monkeypatch.setattr(cooc, "EXTRACT_DEVICE_ELEMS", elems)
+        d, r, sup = cooc.discover_pairs_dense(
+            m, dep_count, cap_code, cap_v1, cap_v2, min_support,
+            num_caps, tile=64)
+        assert set(zip(d.tolist(), r.tolist())) == want, elems
+        assert (sup == dep_count[d]).all()
