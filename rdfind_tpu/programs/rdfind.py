@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "count); 3: + print every CIND")
     p.add_argument("--print-plan", action="store_true",
                    help="dump the logical plan as JSON before executing")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="write an XLA profiler trace of the run (per-op "
+                        "device timings; open with TensorBoard)")
     p.add_argument("--counters", type=int, default=0, dest="counter_level")
     p.add_argument("--dop", type=int, default=1,
                    help="degree of parallelism = number of devices in the mesh")
@@ -215,6 +218,7 @@ def main(argv=None) -> int:
         sbf_bits=args.sbf_bits,
         balanced_11=args.balanced_11,
         print_plan=args.print_plan,
+        profile_dir=args.profile_dir,
         encoding=args.encoding,
         file_filter=args.file_filter,
         rebalance_strategy=args.rebalance_strategy,

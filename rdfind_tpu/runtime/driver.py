@@ -55,6 +55,7 @@ class Config:
     sbf_bits: int = -1  # count-min counter bits (-1 = sized to min_support)
     balanced_11: bool = False  # halve 1/1 emission via pair ownership
     print_plan: bool = False  # dump the logical plan as JSON before executing
+    profile_dir: str | None = None  # XLA profiler trace of the whole run
     encoding: str = "utf-8"  # input charset; "auto" sniffs a BOM per file
     file_filter: str | None = None  # regex on input-file basenames
     # Skew-engine policy (sharded runs; the reference's --rebalance-* flags):
@@ -547,6 +548,19 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
 
 
 def run(cfg: Config) -> RunResult:
+    if cfg.profile_dir:
+        # Device-level observability the reference cannot offer (its tracing
+        # stops at per-plan wall clocks, AbstractFlinkProgram.java:65-77):
+        # one XLA profiler trace over the whole run — per-op device timings,
+        # HLO, memory — viewable in TensorBoard / xprof.
+        import jax
+
+        with jax.profiler.trace(cfg.profile_dir):
+            return _run(cfg)
+    return _run(cfg)
+
+
+def _run(cfg: Config) -> RunResult:
     phases = _Phases()
     counters: dict = {}
 

@@ -339,3 +339,14 @@ def test_rdfind_sharded_ingest_use_ars(tmp_path):
     rep = sorted((tmp_path / "rep.tsv").read_text().splitlines())
     sh = sorted((tmp_path / "sh.tsv").read_text().splitlines())
     assert rep == sh and len(rep) > 0
+
+
+def test_rdfind_profile_dir(tmp_path):
+    """--profile-dir writes an XLA profiler trace of the run."""
+    f = tmp_path / "p.nt"
+    f.write_text("<a> <p> <x> .\n<b> <p> <x> .\n")
+    prof = tmp_path / "trace"
+    assert rdfind.main([str(f), "--support", "1",
+                        "--profile-dir", str(prof)]) == 0
+    dumped = list(prof.rglob("*.xplane.pb")) + list(prof.rglob("*.json.gz"))
+    assert dumped, f"no trace artifacts under {prof}"
