@@ -11,13 +11,13 @@ measurement machinery, AbstractFlinkProgram.java:65-77,175-182): one row per
   Config 2: DBpedia-person-slice-shaped synthetic (~2M triples),
             unary+binary, support >= 100.
 
-Usage: python bench_matrix.py [--configs 1,2] [--strategies 0,1,2]
+Usage: python bench_matrix.py [--configs 1,2] [--strategies 0,1,2,3]
 Prints one JSON line per row, then a summary table on stderr.
 
-CIND-count note: strategies 0/2 emit every CIND; the small-to-large lattice
-(1) emits its raw form, whose 2/1 and 2/2 families omit 1/x-implied members
-by construction (the reference's default behavior) — so its total is lower
-while the 1/1 and 1/2 families match exactly.
+CIND-count note: strategies 0/2 emit every CIND; small-to-large (1) and
+late-BB (3) emit their raw forms, whose 2/1 and 2/2 families omit
+1/x-implied members by construction (the reference's behavior for both) —
+so their totals are lower while the 1/1 and 1/2 families match exactly.
 """
 
 import argparse
@@ -42,7 +42,8 @@ CONFIGS = {
 
 
 def run_one(config_id: int, strategy: int) -> dict:
-    from rdfind_tpu.models import allatonce, approximate, small_to_large
+    from rdfind_tpu.models import (allatonce, approximate, late_bb,
+                                   small_to_large)
     from rdfind_tpu.utils.synth import generate_triples
 
     spec = CONFIGS[config_id]
@@ -51,7 +52,7 @@ def run_one(config_id: int, strategy: int) -> dict:
         from rdfind_tpu.utils.synth import inject_cind_structure
         triples = inject_cind_structure(triples)
     discover = {0: allatonce.discover, 1: small_to_large.discover,
-                2: approximate.discover}[strategy]
+                2: approximate.discover, 3: late_bb.discover}[strategy]
 
     stats: dict = {}
     discover(triples, spec["min_support"], stats=stats)  # warm-up (compile)
@@ -78,7 +79,7 @@ def run_one(config_id: int, strategy: int) -> dict:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="1,2")
-    ap.add_argument("--strategies", default="0,1,2")
+    ap.add_argument("--strategies", default="0,1,2,3")
     args = ap.parse_args()
 
     # The axon tunnel can wedge (block inside a C call); use bench.py's

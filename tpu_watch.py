@@ -7,7 +7,7 @@ indefinitely after a holder is killed; probing in a killable subprocess is
 the only reliable verdict (see bench.py:_probe_tpu_subprocess).
 
 Loop: probe -> on success run `bench.py` (headline) and `bench_matrix.py`
-(configs 1-2 x strategies 0/1/2), append rows to BENCH_TPU_MATRIX.jsonl,
+(configs 1-2 x strategies 0/1/2/3), append rows to BENCH_TPU_MATRIX.jsonl,
 write the headline line to BENCH_TPU_HEADLINE.json, then exit.  On failure
 sleep and retry until --deadline-h expires or a `tpu_watch.stop` file
 appears next to this script.
@@ -68,7 +68,16 @@ def run_benches() -> bool:
                            text=True, timeout=2400, cwd=REPO, env=env)
         line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
         log(f"bench.py rc={r.returncode}: {line[:200]}")
-        on_tpu = r.returncode == 0 and '"backend": "tpu"' in line
+        # Structured check, not a substring: a CPU-fallback line now EMBEDS
+        # the previous TPU artifact (which contains '"backend": "tpu"'), and
+        # must not overwrite it.
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            parsed = {}
+        on_tpu = (r.returncode == 0
+                  and isinstance(parsed.get("detail"), dict)
+                  and parsed["detail"].get("backend") == "tpu")
         if on_tpu:
             # Only a real-TPU row may become the headline artifact (a CPU
             # fallback exiting rc=0 must not masquerade as the TPU number).
@@ -79,7 +88,7 @@ def run_benches() -> bool:
         log("bench.py timed out (2400s)")
         ok = False
 
-    log("running bench_matrix.py (configs 1-2 x strategies 0,1,2)...")
+    log("running bench_matrix.py (configs 1-2 x strategies 0,1,2,3)...")
     try:
         r = subprocess.run([sys.executable, "bench_matrix.py"],
                            capture_output=True, text=True, timeout=5400,
