@@ -54,6 +54,21 @@ def probe(timeout_s: int = 120) -> bool:
     return False
 
 
+def is_tpu_bench_line(line: str) -> bool:
+    """True iff a bench.py output line is a REAL on-chip measurement.
+
+    Structured check, not a substring: a CPU-fallback line EMBEDS the
+    previous TPU artifact (which contains '"backend": "tpu"' inside
+    detail.tpu_headline_artifact), and must not overwrite it."""
+    try:
+        parsed = json.loads(line)
+    except json.JSONDecodeError:
+        return False
+    return (isinstance(parsed, dict)
+            and isinstance(parsed.get("detail"), dict)
+            and parsed["detail"].get("backend") == "tpu")
+
+
 def run_benches() -> bool:
     """Run the headline bench + the config matrix on the (live) TPU.
 
@@ -68,16 +83,7 @@ def run_benches() -> bool:
                            text=True, timeout=2400, cwd=REPO, env=env)
         line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
         log(f"bench.py rc={r.returncode}: {line[:200]}")
-        # Structured check, not a substring: a CPU-fallback line now EMBEDS
-        # the previous TPU artifact (which contains '"backend": "tpu"'), and
-        # must not overwrite it.
-        try:
-            parsed = json.loads(line)
-        except json.JSONDecodeError:
-            parsed = {}
-        on_tpu = (r.returncode == 0
-                  and isinstance(parsed.get("detail"), dict)
-                  and parsed["detail"].get("backend") == "tpu")
+        on_tpu = r.returncode == 0 and is_tpu_bench_line(line)
         if on_tpu:
             # Only a real-TPU row may become the headline artifact (a CPU
             # fallback exiting rc=0 must not masquerade as the TPU number).
