@@ -273,3 +273,45 @@ def test_two_process_sharded_ingest_ars(tmp_path):
     want_cinds, want_ars = run("replicated", None)
     assert got_ars == want_ars and len(want_ars) > 0
     assert got_cinds == want_cinds
+
+
+def test_two_process_sharded_ingest_checkpoint_resume(tmp_path):
+    """Checkpoint/resume across REAL process boundaries: per-host ingest
+    caches plus the all-hosts-agree discover resume (a partial hit must not
+    desync the collectives)."""
+    paths = []
+    for i, content in enumerate(NT_SHARDS[:2]):
+        p = tmp_path / f"shard{i}.nt"
+        p.write_text(content)
+        paths.append(str(p))
+    ck = tmp_path / "ck"
+
+    def run(tag):
+        out = tmp_path / f"{tag}.tsv"
+        port = _free_port()
+        outs = _run_procs(
+            [[sys.executable, "-m", "rdfind_tpu.programs.rdfind", *paths,
+              "--support", "1", "--sharded-ingest", "--counters", "1",
+              "--checkpoint-dir", str(ck), "--output", str(out),
+              "--coordinator", f"127.0.0.1:{port}",
+              "--num-hosts", "2", "--host-index", str(pid)]
+             for pid in range(2)], _cpu_env(fake_devices=4))
+        return out.read_text(), outs[0][1]
+
+    first_out, first_err = run("first")
+    assert "resumed-ingest" not in first_err
+    assert {p.name for p in ck.glob("*.npz")} >= {
+        "ingest-host0.npz", "ingest-host1.npz",
+        "discover-host0.npz", "discover-host1.npz"}
+    second_out, second_err = run("second")
+    assert "resumed-ingest: 1" in second_err
+    assert "resumed-discover: 1" in second_err
+    assert second_out == first_out
+
+    # Partial hit: host 1 loses its discover checkpoint -> NO host may
+    # resume discovery (all-hosts-agree), and the run still completes.
+    (ck / "discover-host1.npz").unlink()
+    third_out, third_err = run("third")
+    assert "resumed-discover" not in third_err
+    assert "resumed-ingest: 1" in third_err  # ingest caches are per-host
+    assert third_out == first_out
