@@ -46,11 +46,16 @@ DEP_TILE = 1 << 12
 
 def _build_sketches(line_val_h, line_cap_h, num_caps, *, bits, num_hashes,
                     row_budget=sketch.BUILD_ROW_BUDGET):
-    """Packed (num_caps, bits//32) refset sketches from host join-line rows.
+    """Packed (cap_pad, bits//32) refset sketches, RESIDENT ON DEVICE.
 
     Rows arrive sorted by (join value, capture).  Line Blooms are built per
-    line-aligned chunk; dependent sketches are AND-accumulated across chunks (a
-    capture's rows may span chunks), packed-AND on host between device stages.
+    line-aligned chunk; dependent sketches are AND-accumulated across chunks
+    on device (sketch.intersect_dep_sketches_acc) — nothing crosses the
+    tunnel during the build (r4 pulled every partial sketch matrix to host
+    and ANDed in numpy; VERDICT's first strategy-2 bottleneck).  cap_pad is
+    the pow2 capacity of num_caps so compiled programs are shared across
+    datasets; padded captures keep the all-ones empty-AND sketch and are
+    masked out by _candidate_pairs' dep/ref masks.
     """
     n = line_val_h.shape[0]
     starts = np.empty(n, bool)
@@ -60,7 +65,8 @@ def _build_sketches(line_val_h, line_cap_h, num_caps, *, bits, num_hashes,
     line_start_rows = np.flatnonzero(starts)
     num_lines = len(line_start_rows)
 
-    sketches = np.full((num_caps, bits // 32), 0xFFFFFFFF, np.uint32)
+    cap_pad = segments.pow2_capacity(num_caps)
+    sketches = jnp.full((cap_pad, bits // 32), 0xFFFFFFFF, jnp.uint32)
     # Chunk over whole lines so each line's Bloom is complete within its chunk.
     chunk_first_line = 0
     while chunk_first_line < num_lines:
@@ -80,56 +86,73 @@ def _build_sketches(line_val_h, line_cap_h, num_caps, *, bits, num_hashes,
         gid_local = (line_gid[rows] - chunk_first_line).astype(np.int32)
         cap_local = line_cap_h[rows]
         valid = jnp.arange(row_cap, dtype=jnp.int32) < m
+        gid_d = jnp.asarray(pad(gid_local, row_cap, 0))
+        cap_d = jnp.asarray(pad(cap_local, row_cap, 0))
         blooms = sketch.build_line_blooms(
-            jnp.asarray(pad(gid_local, row_cap, 0)),
-            jnp.asarray(pad(cap_local, row_cap, 0)), valid,
+            gid_d, cap_d, valid,
             num_lines=lines_cap, bits=bits, num_hashes=num_hashes)
-        part = sketch.intersect_dep_sketches(
-            jnp.asarray(pad(cap_local, row_cap, 0)),
-            blooms[jnp.asarray(pad(gid_local, row_cap, 0))], valid,
-            num_caps=num_caps, bits=bits)
-        sketches &= np.asarray(part)
+        sketches = sketch.intersect_dep_sketches_acc(
+            sketches, cap_d, blooms[gid_d], valid)
         chunk_first_line = last
     return sketches
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "bits", "num_hashes"))
+def _stage_cand_tile(sketches, lo, dep_ok, ref_ok, ref_ids, ref_pack, *,
+                     tile: int, bits: int, num_hashes: int):
+    """One (tile x cap_pad) candidate block, bit-packed along refs.
+
+    Slices the device-resident sketch matrix, runs the containment matmul,
+    applies the dep/ref masks and the no-self-pair diagonal, and packs —
+    the host never sees the bool matrix, only the decoded index pairs
+    (the strategy-0 decode discipline, ops/cooc.py).
+    """
+    tile_sk = jax.lax.dynamic_slice(sketches, (lo, 0),
+                                    (tile, sketches.shape[1]))
+    cand = sketch.contains_matrix(tile_sk, ref_ids, ref_ok, bits=bits,
+                                  num_hashes=num_hashes, ref_pack=ref_pack)
+    d_idx = lo + jnp.arange(tile, dtype=jnp.int32)
+    cand &= dep_ok[d_idx][:, None]
+    cand &= d_idx[:, None] != ref_ids[None, :]
+    return cooc_ops.pack_bool(cand)
 
 
 def _candidate_pairs(sketches, num_caps, *, bits, num_hashes,
                      dep_mask=None, ref_mask=None, dep_tile=DEP_TILE):
     """All (dep, ref) capture-id pairs passing the sketch test, dep != ref.
 
-    Tiled over dependents; each tile is one MXU containment matmul.  Optional
-    dep_mask/ref_mask restrict either side (used by the LateBB rounds).
+    Tiled over dependents; each tile is one MXU containment matmul whose
+    masked output is bit-packed on device and decoded by popcount + sized
+    nonzero (cooc_ops.extract_packed), so only the candidate index pairs
+    travel to the host — never the (tile x caps) bool matrix (r4 pulled the
+    full uint8 matrix per tile; VERDICT's second strategy-2 bottleneck).
+    Optional dep_mask/ref_mask restrict either side (the LateBB rounds).
     """
-    # Pad both sides to bucketed capacities so contains_matrix compiles once per
-    # (tile, ref_cap) bucket instead of once per dataset (pow2 capacity policy).
-    ref_cap = segments.pow2_capacity(num_caps)
-    ref_ids = jnp.arange(ref_cap, dtype=jnp.int32)
-    ref_ok_h = np.zeros(ref_cap, bool)
+    cap_pad = sketches.shape[0]
+    tile = min(dep_tile, cap_pad)
+    ref_ids = jnp.arange(cap_pad, dtype=jnp.int32)
+    ref_ok_h = np.zeros(cap_pad, bool)
     ref_ok_h[:num_caps] = True if ref_mask is None else ref_mask[:num_caps]
     ref_ok = jnp.asarray(ref_ok_h)
+    dep_ok_h = np.zeros(cap_pad, bool)
+    dep_ok_h[:num_caps] = True if dep_mask is None else dep_mask[:num_caps]
+    dep_ok = jnp.asarray(dep_ok_h)
     # Pack the shared ref side once; every dep tile reuses it (pallas backend).
     ref_pack = (sketch.pack_ref_bits(ref_ids, bits=bits, num_hashes=num_hashes)
                 if sketch.pallas_eligible(bits) else None)
-    out_d, out_r = [], []
-    for lo in range(0, num_caps, dep_tile):
-        hi = min(lo + dep_tile, num_caps)
-        if dep_mask is not None and not dep_mask[lo:hi].any():
-            continue
-        tile_h = sketches[lo:hi]
-        if tile_h.shape[0] < dep_tile:
-            tile_h = np.concatenate([tile_h, np.zeros(
-                (dep_tile - tile_h.shape[0], tile_h.shape[1]), tile_h.dtype)])
-        cand = np.array(sketch.contains_matrix(
-            jnp.asarray(tile_h), ref_ids, ref_ok, bits=bits,
-            num_hashes=num_hashes, ref_pack=ref_pack))[:hi - lo, :num_caps]
-        if dep_mask is not None:
-            cand &= dep_mask[lo:hi, None]
-        d, r = np.nonzero(cand)
-        d = d.astype(np.int64) + lo
-        r = r.astype(np.int64)
-        keep = d != r
-        out_d.append(d[keep])
-        out_r.append(r[keep])
+    los = [lo for lo in range(0, num_caps, tile)
+           if dep_mask is None or dep_mask[lo:min(lo + tile, num_caps)].any()]
+
+    def make(lo):
+        return lambda: (_stage_cand_tile(sketches, jnp.int32(lo), dep_ok,
+                                         ref_ok, ref_ids, ref_pack, tile=tile,
+                                         bits=bits, num_hashes=num_hashes),
+                        min(num_caps - lo, tile), num_caps)
+
+    pairs = cooc_ops.extract_packed_iter([make(lo) for lo in los],
+                                         tile * cap_pad)
+    out_d = [d + lo for lo, (d, _) in zip(los, pairs) if d.size]
+    out_r = [r for _, (d, r) in zip(los, pairs) if d.size]
     if not out_d:
         z = np.zeros(0, np.int64)
         return z, z
@@ -309,6 +332,9 @@ def discover(triples, min_support: int, projections: str = "spo",
                                           dep_mask=frequent, ref_mask=frequent)
     if stats is not None:
         stats["n_sketch_candidates"] = len(cand_dep)
+    # The sketch matrix is dead past candidate generation; drop the reference
+    # so its HBM is free for round 2's membership matrix.
+    del sketches
 
     d, r, sup = verify_candidates(
         st, cand_dep, cand_ref, min_support, pair_backend=pair_backend,

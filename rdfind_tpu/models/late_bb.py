@@ -69,6 +69,8 @@ def discover(triples, min_support: int, projections: str = "spo",
     cand_dep, cand_ref = approximate._candidate_pairs(
         sketches, num_caps, bits=sketch_bits, num_hashes=sketch_hashes,
         dep_mask=frequent, ref_mask=frequent)
+    # Dead past candidate generation; free its HBM before the verify rounds.
+    del sketches
     dep_is_unary = unary[cand_dep]
 
     # Round 1: unary dependents, refs of both arities.

@@ -167,9 +167,12 @@ def _inbounds(packed, rows, cols):
     repo's program-reuse policy across lattice levels and datasets."""
     word_idx = jnp.arange(packed.shape[1], dtype=jnp.int32)
     partial = jnp.clip(cols - word_idx * 32, 0, 32)
-    col_mask = jnp.where(partial >= 32, jnp.uint32(0xFFFFFFFF),
-                         (jnp.uint32(1) << partial.astype(jnp.uint32))
-                         - jnp.uint32(1))
+    # Shift stays in [0, 31]: uint32 << 32 is implementation-defined in XLA,
+    # so the partial == 32 case selects the full mask without ever evaluating
+    # an out-of-range shift (even in an unselected where branch).
+    low = (jnp.uint32(1) << jnp.minimum(partial, 31).astype(jnp.uint32)) \
+        - jnp.uint32(1)
+    col_mask = jnp.where(partial >= 32, jnp.uint32(0xFFFFFFFF), low)
     row_ok = jnp.arange(packed.shape[0], dtype=jnp.int32) < rows
     return jnp.where(row_ok[:, None], packed & col_mask[None, :], 0)
 
@@ -193,10 +196,29 @@ def packed_nonzero(packed, rows, cols, *, cap: int):
 
 
 # Device extraction materializes the unpacked relation plus nonzero's scan
-# intermediates; past this element count the HBM cost exceeds the transfer
-# saving and extract_packed decodes on the host instead (which uses no device
-# memory at all).  2^28 bits also keeps packed_count's int32 sum exact.
+# intermediates; past this element count a relation decodes in row strips so
+# each strip's intermediates stay under the bound.  2^28 bits also keeps
+# packed_count's int32 sum exact.
 EXTRACT_DEVICE_ELEMS = 1 << 28
+
+# Device bytes pinned by pending sized-nonzero outputs before a batched pull
+# flush.  Without it, near-dense relations could pin index pairs proportional
+# to the total set-bit count (32 GB for a saturated 4096 x 2^20-bit sweep)
+# while waiting for one giant device_get.
+PULL_BYTES_BUDGET = 1 << 28
+
+
+def _flush_pulls(pend):
+    """One batched device_get of pending sized-nonzero outputs.
+
+    pend: list of (key, count, (d_dev, r_dev)).  Returns [(key, d, r)] with
+    host int64 arrays truncated to their exact counts."""
+    flat = iter(jax.device_get([x for _, _, dr in pend for x in dr]))
+    out = []
+    for key, c, _ in pend:
+        d, r = next(flat), next(flat)
+        out.append((key, d[:c].astype(np.int64), r[:c].astype(np.int64)))
+    return out
 
 
 def extract_packed(packed, rows: int, cols: int):
@@ -206,20 +228,87 @@ def extract_packed(packed, rows: int, cols: int):
     then a sized nonzero — so the host pulls one scalar plus exactly the
     set-bit index pairs, never the bit matrix itself (the multi-MB pull +
     host unpackbits scan dominated the lattice's non-matmul wall clock over
-    the tunnel).  Oversized relations fall back to the zero-HBM host decode."""
-    if packed.shape[0] * packed.shape[1] * 32 > EXTRACT_DEVICE_ELEMS:
-        bits = unpack_cind_bits(np.asarray(packed), packed.shape[1] * 32)
-        d, r = np.nonzero(bits[:rows, :cols])
-        return d.astype(np.int64), r.astype(np.int64)
-    n = int(np.asarray(packed_count(packed, jnp.int32(rows),
-                                    jnp.int32(cols))))
-    if n == 0:
+    the tunnel).  Oversized relations decode in row strips: each strip's
+    unpacked planes + nonzero intermediates stay <= EXTRACT_DEVICE_ELEMS
+    bits on device, counts and index pulls are batched so the host syncs
+    twice total, and the bit matrix still never crosses the tunnel (the r4
+    host fallback pulled C^2/32 bytes per oversized tile — strategy 2's
+    second measured bottleneck)."""
+    words = packed.shape[1]
+    if packed.shape[0] * words * 32 <= EXTRACT_DEVICE_ELEMS:
+        n = int(np.asarray(packed_count(packed, jnp.int32(rows),
+                                        jnp.int32(cols))))
+        if n == 0:
+            z = np.zeros(0, np.int64)
+            return z, z
+        d, r = jax.device_get(packed_nonzero(
+            packed, jnp.int32(rows), jnp.int32(cols),
+            cap=segments.pow2_capacity(n)))
+        return d[:n].astype(np.int64), r[:n].astype(np.int64)
+    # Strip heights stay pow2 (words is pow2 by the c_pad policy), so every
+    # strip of a pow2-height tile is full height and program reuse holds.
+    # Strips are just same-shaped small tiles: decode through the shared
+    # batched iterator.  tile_bits is clamped for the pathological one-row-
+    # over-budget shape (words*32 > EXTRACT_DEVICE_ELEMS), where a single
+    # row must decode in one shot anyway and clamping avoids bouncing back
+    # into this strip path.
+    h = max(1, EXTRACT_DEVICE_ELEMS // (words * 32))
+    los = list(range(0, min(rows, packed.shape[0]), h))
+
+    def make(lo):
+        return lambda: (packed[lo:lo + h], min(rows - lo, h), cols)
+
+    pairs = extract_packed_iter([make(lo) for lo in los],
+                                min(h * words * 32, EXTRACT_DEVICE_ELEMS))
+    out_d = [d + lo for lo, (d, _) in zip(los, pairs) if d.size]
+    out_r = [r for _, (d, r) in zip(los, pairs) if d.size]
+    if not out_d:
         z = np.zeros(0, np.int64)
         return z, z
-    d, r = jax.device_get(packed_nonzero(
-        packed, jnp.int32(rows), jnp.int32(cols),
-        cap=segments.pow2_capacity(n)))
-    return d[:n].astype(np.int64), r[:n].astype(np.int64)
+    return np.concatenate(out_d), np.concatenate(out_r)
+
+
+def extract_packed_iter(thunks, tile_bits: int):
+    """Decode a stream of same-shaped packed tiles with batched host syncs.
+
+    thunks: callables dispatching one tile each, returning (packed, rows,
+    cols); tile_bits: packed bits per tile, which bounds how many tiles sit
+    on device awaiting decode (EXTRACT_DEVICE_ELEMS per batch).  Each batch
+    costs one counts sync; index pulls flush under PULL_BYTES_BUDGET.
+    Oversized tiles fall through to extract_packed's strip decode.  Returns
+    [(d, r)] host int64 arrays in thunk order — the shared decode behind
+    the dense strategy-0 sweep and strategy 2's candidate generation.
+    """
+    if tile_bits > EXTRACT_DEVICE_ELEMS:
+        return [extract_packed(*t()) for t in thunks]
+    out = [None] * len(thunks)
+    batch = max(1, EXTRACT_DEVICE_ELEMS // tile_bits)
+    empty = (np.zeros(0, np.int64), np.zeros(0, np.int64))
+    for i in range(0, len(thunks), batch):
+        group = [(i + j, *t()) for j, t in enumerate(thunks[i:i + batch])]
+        counts = jax.device_get([packed_count(p, jnp.int32(r), jnp.int32(c))
+                                 for _, p, r, c in group])
+        pend, pend_bytes = [], 0
+
+        def drain():
+            nonlocal pend, pend_bytes
+            for k, d, r in _flush_pulls(pend):
+                out[k] = (d, r)
+            pend, pend_bytes = [], 0
+
+        for n, (k, p, rows, cols) in zip(counts, group):
+            n = int(n)
+            if not n:
+                out[k] = empty
+                continue
+            cap = segments.pow2_capacity(n)
+            pend.append((k, n, packed_nonzero(p, jnp.int32(rows),
+                                              jnp.int32(cols), cap=cap)))
+            pend_bytes += 8 * cap
+            if pend_bytes >= PULL_BYTES_BUDGET:
+                drain()
+        drain()
+    return out
 
 
 def unpack_cind_bits(packed: np.ndarray, c_pad: int) -> np.ndarray:
@@ -246,40 +335,16 @@ def discover_pairs_dense(m, dep_count, cap_code, cap_v1, cap_v2, min_support,
     v2_d = jnp.asarray(cap_v2, jnp.int32)
     ms = jnp.int32(min_support)
 
-    # Tiles decode in bounded batches: each batch pins at most
-    # EXTRACT_DEVICE_ELEMS packed bits on device (plus its sized-nonzero
-    # outputs) and costs two round trips — counts, then index pairs — so
-    # decode residency stays bounded while round trips stay
-    # O(total_bits / EXTRACT_DEVICE_ELEMS).  An oversized single tile makes
-    # batch=1 and extract_packed itself takes its zero-HBM host path.
-    batch = max(1, EXTRACT_DEVICE_ELEMS // (tile * c_pad))
     los = list(range(0, num_caps, tile))
-    deps, refs = [], []
-    for i in range(0, len(los), batch):
-        tiles = [(lo, cooc_cind_tile(m, jnp.int32(lo), dep_count_d, code_d,
-                                     v1_d, v2_d, ms, tile=tile))
-                 for lo in los[i:i + batch]]
-        if len(tiles) == 1:
-            lo, packed = tiles[0]
-            d_off, r = extract_packed(packed, min(num_caps - lo, tile),
-                                      num_caps)
-            deps.append(d_off + lo)
-            refs.append(r)
-            continue
-        counts = jax.device_get(
-            [packed_count(p, jnp.int32(min(num_caps - lo, tile)),
-                          jnp.int32(num_caps)) for lo, p in tiles])
-        pulls = [packed_nonzero(p, jnp.int32(min(num_caps - lo, tile)),
-                                jnp.int32(num_caps),
-                                cap=segments.pow2_capacity(int(n)))
-                 for n, (lo, p) in zip(counts, tiles) if int(n)]
-        flat = iter(jax.device_get([x for dr in pulls for x in dr]))
-        for n, (lo, _) in zip((int(c) for c in counts), tiles):
-            if not n:
-                continue
-            d_off, r = next(flat), next(flat)
-            deps.append(d_off[:n].astype(np.int64) + lo)
-            refs.append(r[:n].astype(np.int64))
+
+    def make(lo):
+        return lambda: (cooc_cind_tile(m, jnp.int32(lo), dep_count_d, code_d,
+                                       v1_d, v2_d, ms, tile=tile),
+                        min(num_caps - lo, tile), num_caps)
+
+    pairs = extract_packed_iter([make(lo) for lo in los], tile * c_pad)
+    deps = [d + lo for lo, (d, _) in zip(los, pairs) if d.size]
+    refs = [r for _, (d, r) in zip(los, pairs) if d.size]
     dep_id = np.concatenate(deps) if deps else np.zeros(0, np.int64)
     ref_id = np.concatenate(refs) if refs else np.zeros(0, np.int64)
     support = np.asarray(dep_count)[dep_id] if dep_id.size else np.zeros(0, np.int64)

@@ -104,6 +104,25 @@ def intersect_dep_sketches(cap_id, line_bloom_rows, valid, *, num_caps: int,
     return pack_planes(acc)
 
 
+@jax.jit
+def intersect_dep_sketches_acc(acc, cap_id, line_bloom_rows, valid):
+    """AND-accumulate one chunk's per-dependent sketches into `acc`.
+
+    acc: (num_caps, W) packed sketches resident on device.  Equivalent to
+    `acc & intersect_dep_sketches(...)` fused into one program with no host
+    round trip — the r4 build pulled every chunk's partial sketch matrix to
+    host and ANDed in numpy, which was strategy 2's first measured bottleneck
+    (the AND of Blooms itself is the reference's BloomFilter.intersect,
+    IntersectHalfApproximateCindCandidates.scala:40-44).
+    """
+    num_caps = acc.shape[0]
+    planes = unpack_planes(line_bloom_rows)
+    ci = jnp.where(valid, cap_id, num_caps)
+    accp = unpack_planes(acc)
+    accp = accp.at[ci].min(planes, mode="drop")
+    return pack_planes(accp)
+
+
 @functools.partial(jax.jit, static_argnames=("bits", "num_hashes"))
 def pack_ref_bits(ref_ids, *, bits: int, num_hashes: int):
     """Packed (R, bits//32) uint32 bit sets of each ref id's k hash positions,

@@ -193,8 +193,9 @@ def test_engine_int8_membership_matches(monkeypatch):
 
 def test_discover_pairs_dense_tiled(monkeypatch):
     """The tiled dense sweep (the c_pad > SINGLE_SHOT_C fallback) against a
-    numpy oracle, on both decode branches (batched device nonzero and the
-    oversized host fallback)."""
+    numpy oracle, on all decode branches: single-shot batched nonzero,
+    multi-batch tile decode with a tiny pull budget (mid-stream drains),
+    multi-row device strips, and single-row strips."""
     import jax.numpy as jnp
 
     from rdfind_tpu.ops import cooc
@@ -217,8 +218,13 @@ def test_discover_pairs_dense_tiled(monkeypatch):
         & ~np.eye(c_pad, dtype=bool)))
         if d < num_caps and r < num_caps}
 
-    for elems in (1 << 28, 1):  # device decode, then forced host fallback
+    # (EXTRACT_DEVICE_ELEMS, PULL_BYTES_BUDGET): tile_bits = 64*256 = 16384,
+    # so 1<<28 = one batch; 32768 = 2-tile batches with per-pend drains;
+    # 2048/1 = oversized fallback into 8-row / 1-row strips.
+    for elems, pull_budget in ((1 << 28, 1 << 28), (32768, 64),
+                               (2048, 1 << 28), (1, 32)):
         monkeypatch.setattr(cooc, "EXTRACT_DEVICE_ELEMS", elems)
+        monkeypatch.setattr(cooc, "PULL_BYTES_BUDGET", pull_budget)
         d, r, sup = cooc.discover_pairs_dense(
             m, dep_count, cap_code, cap_v1, cap_v2, min_support,
             num_caps, tile=64)
