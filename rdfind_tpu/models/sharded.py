@@ -1510,13 +1510,15 @@ def _stage_mine_ars(mesh, cap_counts: int, cap_rules: int):
         bucket = hashing.bucket_of(u_cols[:4], d, seed=419)
         recv, recv_valid, o_r, _ = exchange.route(u_cols, u_valid, bucket,
                                                   AXIS, cap_rules)
-        ovf += o_r
         r_cols, r_valid, _, _ = segments.masked_unique(recv, recv_valid)
-        return (*r_cols, r_valid, ovf)
+        # Count-exchange and rule-route overflows stay separate so retries
+        # grow only the buffer that actually overflowed (D*capacity-sized
+        # route buffers are the scarce resource here).
+        return (*r_cols, r_valid, ovf, o_r)
 
     return jax.jit(jax.shard_map(
         f, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS), P()),
-        out_specs=(*([P(AXIS)] * 6), P())))
+        out_specs=(*([P(AXIS)] * 6), P(), P())))
 
 
 def mine_ars_sharded(g_triples, g_valid, min_support: int, mesh,
@@ -1530,19 +1532,23 @@ def mine_ars_sharded(g_triples, g_valid, min_support: int, mesh,
     cap_rules = _headroom(CAP_FLOOR)
     for _ in range(max_retries):
         prog = _stage_mine_ars(mesh, cap_counts, cap_rules)
-        *cols, r_valid, ovf = prog(g_triples, g_valid,
-                                   jnp.int32(max(int(min_support), 1)))
-        ovf = int(np.asarray(host_gather(ovf)).reshape(-1)[0])
-        if ovf == 0:
+        *cols, r_valid, ovf_c, ovf_r = prog(g_triples, g_valid,
+                                            jnp.int32(max(int(min_support),
+                                                          1)))
+        ovf_c = int(np.asarray(host_gather(ovf_c)).reshape(-1)[0])
+        ovf_r = int(np.asarray(host_gather(ovf_r)).reshape(-1)[0])
+        if ovf_c == 0 and ovf_r == 0:
             break
-        cap_counts = segments.pow2_capacity(2 * cap_counts + ovf)
-        cap_rules = segments.pow2_capacity(2 * cap_rules + ovf)
+        if ovf_c:
+            cap_counts = segments.pow2_capacity(2 * cap_counts + ovf_c)
+        if ovf_r:
+            cap_rules = segments.pow2_capacity(2 * cap_rules + ovf_r)
         _check_exchange_caps(num_dev, ar_counts=cap_counts,
                              ar_rules=cap_rules)
     else:
         raise RuntimeError(
             f"association-rule exchange overflow persisted after "
-            f"{max_retries} retries (ovf={ovf})")
+            f"{max_retries} retries (ovf={ovf_c}+{ovf_r})")
     keep = np.asarray(host_gather(r_valid))
     return [np.asarray(host_gather(c))[keep] for c in cols]
 

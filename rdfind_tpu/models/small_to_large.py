@@ -640,35 +640,15 @@ def _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
         sub_ok, code_b, v1_b, v2_b, freq_d)
     stat_add("pairs_22", u22, n_cand22)
 
-    # Batched two-phase decode of the three binary relations: one pull of all
-    # counts (+ n_inf), then one pull of all sized nonzeros — two round trips
-    # total instead of two per relation (extract_packed's single-caller API).
+    # Decode the three binary relations through the shared batched two-phase
+    # decoder (cooc_ops.extract_packed_iter, which also strip-decodes any
+    # oversized relation); n_inf rides its own one-scalar pull.
     relations = [(cind12_packed, num_caps, nb), (cind21_packed, nb, num_caps),
                  (cind22_packed, nb, nb)]
-    oversized = any(p.shape[0] * p.shape[1] * 32
-                    > cooc_ops.EXTRACT_DEVICE_ELEMS for p, _, _ in relations)
-    if oversized:
-        n_inf_h = jax.device_get(n_inf)
-        pairs_brc = [cooc_ops.extract_packed(p, r_, c_)
-                     for p, r_, c_ in relations]
-    else:
-        *counts, n_inf_h = jax.device_get(
-            [cooc_ops.packed_count(p, jnp.int32(r_), jnp.int32(c_))
-             for p, r_, c_ in relations] + [n_inf])
-        pulls = [cooc_ops.packed_nonzero(
-                     p, jnp.int32(r_), jnp.int32(c_),
-                     cap=segments.pow2_capacity(int(n)))
-                 for n, (p, r_, c_) in zip(counts, relations) if int(n)]
-        flat = iter(jax.device_get([x for dr in pulls for x in dr]))
-        pairs_brc = []
-        for n in (int(c) for c in counts):
-            if n:
-                d_, r_ = next(flat), next(flat)
-                pairs_brc.append((d_[:n].astype(np.int64),
-                                  r_[:n].astype(np.int64)))
-            else:
-                z = np.zeros(0, np.int64)
-                pairs_brc.append((z, z))
+    n_inf_h = jax.device_get(n_inf)
+    pairs_brc = cooc_ops.extract_packed_iter(
+        [lambda p=p, rr=rr, rc=rc: (p, rr, rc) for p, rr, rc in relations],
+        max(p.shape[0] * p.shape[1] * 32 for p, _, _ in relations))
     (d12, r12b), (d21b, r21), (d22b, r22b) = pairs_brc
     r12 = bin_ids_h[r12b]
     d21 = bin_ids_h[d21b]

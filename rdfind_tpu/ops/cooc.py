@@ -235,16 +235,10 @@ def extract_packed(packed, rows: int, cols: int):
     host fallback pulled C^2/32 bytes per oversized tile — strategy 2's
     second measured bottleneck)."""
     words = packed.shape[1]
-    if packed.shape[0] * words * 32 <= EXTRACT_DEVICE_ELEMS:
-        n = int(np.asarray(packed_count(packed, jnp.int32(rows),
-                                        jnp.int32(cols))))
-        if n == 0:
-            z = np.zeros(0, np.int64)
-            return z, z
-        d, r = jax.device_get(packed_nonzero(
-            packed, jnp.int32(rows), jnp.int32(cols),
-            cap=segments.pow2_capacity(n)))
-        return d[:n].astype(np.int64), r[:n].astype(np.int64)
+    total_bits = packed.shape[0] * words * 32
+    if total_bits <= EXTRACT_DEVICE_ELEMS:
+        return extract_packed_iter([lambda: (packed, rows, cols)],
+                                   total_bits)[0]
     # Strip heights stay pow2 (words is pow2 by the c_pad policy), so every
     # strip of a pow2-height tile is full height and program reuse holds.
     # Strips are just same-shaped small tiles: decode through the shared

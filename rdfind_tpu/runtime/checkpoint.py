@@ -155,6 +155,11 @@ def decode_stats(arrays: dict) -> dict:
         return {}
     stats = json.loads(bytes(arrays["__stats__"]).decode())
     if "__rules_0__" in arrays:
-        stats["association_rules"] = [
-            arrays[f"__rules_{i}__"] for i in range(5)]
+        # Column count derives from the stored keys, not a hard-coded schema:
+        # a rule-table shape change then reads back exactly what was written
+        # instead of raising KeyError outside the corrupt-file guard.
+        cols = []
+        while f"__rules_{len(cols)}__" in arrays:
+            cols.append(arrays[f"__rules_{len(cols)}__"])
+        stats["association_rules"] = cols
     return stats
