@@ -135,7 +135,11 @@ class SkewPolicy:
             jnp.maximum(avg_load * self.factor, jnp.float32(_MIN_SPLIT_LOAD)),
             jnp.float32(self.max_load))
         if cap_pairs is not None:  # absolute pair-budget backstop
-            t = jnp.minimum(t, jnp.float32(cap_pairs // 4))
+            # cap_pairs may be a traced f32 scalar (the multipass pair phase
+            # passes the FULL budget cap_p * n_pass, since per-line emission
+            # is dep-sliced ~1/n_pass per pass — a per-pass backstop would
+            # reclassify mid-size lines as giant vs the backstop-free plan).
+            t = jnp.minimum(t, jnp.asarray(cap_pairs, jnp.float32) // 4)
         return t
 
 
@@ -146,6 +150,7 @@ DEFAULT_SKEW = SkewPolicy()
 _SEED_VALUE = 1     # exchange A: join value
 _SEED_CAPTURE = 2   # exchange B + exchange C: capture key
 _SEED_GIANT = 5     # giant-line dependent ownership
+_SEED_PASS = 7      # dep-slice selection for bounded-memory pair passes
 _SEED_UNARY = 11    # +f, f in 0..2: frequency count exchanges
 _SEED_BINARY = 17   # +k, k in 0..2
 
@@ -441,7 +446,7 @@ def _captures_step(jv, code, v1, v2, n_rows, *, mesh, cap_exchange_b):
 
 def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
                 cap_exchange_c, cap_giant, cap_giant_pairs,
-                skew=DEFAULT_SKEW):
+                skew=DEFAULT_SKEW, pass_idx=None, n_pass=None):
     """Skew-aware masked pair counting over value-sorted line rows.
 
     Emits all ordered co-occurrence pairs whose dependent row is dep-flagged and
@@ -449,6 +454,17 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
     passes the level's candidate flags), splitting oversized lines across the
     mesh, then routes pair partials to the dependent capture's owner (seed 2)
     and merges counts there.
+
+    pass_idx/n_pass (traced int32 scalars) select one dep-slice PASS: only
+    rows whose capture hashes to pass_idx (mod n_pass) emit pairs, so pair
+    buffers, the exchange, and the merge all shrink by ~n_pass while the
+    resident join lines are reread in place.  Slices partition the dependent
+    captures, so per-pass outputs concatenate with no cross-pass merge.
+    This is the bounded-memory analog of the reference's windowed merge
+    under heap pressure (BulkMergeDependencies.scala:96-104) — multi-pass
+    streaming over resident data instead of Flink's disk spill.  Emission
+    masking (ops/pairs.emit_pair_indices `emit`) means non-emitting rows
+    take zero buffer slots; n_pairs_total counts EMITTED pairs.
 
     Returns (ucols(6), uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp),
     n_giant_lines, n_giant_pairs, n_pairs_total).
@@ -459,6 +475,9 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows
     dep_f = dep_f & valid
     ref_f = ref_f & valid
+    if n_pass is not None:
+        dep_f = dep_f & (hashing.bucket_of([code, v1, v2], n_pass,
+                                           seed=_SEED_PASS) == pass_idx)
 
     # Skew stats: per-line quadratic load + global average (f32: loads overflow
     # int32 long before they overflow the threshold math's precision needs).
@@ -469,16 +488,21 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
     total_load = jax.lax.psum(jnp.where(is_start, load_f, 0.0).sum(), AXIS)
     total_lines = jax.lax.psum(is_start.sum(), AXIS)
     avg_load = total_load / jnp.maximum(total_lines, 1).astype(jnp.float32)
-    thresh = skew.split_threshold(avg_load, cap_pairs)
+    full_budget = (jnp.float32(cap_pairs) if n_pass is None
+                   else jnp.float32(cap_pairs) * n_pass.astype(jnp.float32))
+    thresh = skew.split_threshold(avg_load, full_budget)
     is_giant = valid & (load_f > thresh)
     n_giant_lines = jax.lax.psum((is_start & is_giant).sum(), AXIS)
 
     # Pair emission for normal lines (giant rows get length 1 => no pairs).
+    # Only dep-flagged rows emit: S2L levels and dep-slice passes allocate
+    # buffer slots proportional to their actual work, not the full quadratic.
     length_n = jnp.where(is_giant, 1, length)
-    total_norm = pairs.saturating_cumsum(jnp.where(valid, length_n - 1, 0))[-1]
+    total_norm = pairs.saturating_cumsum(
+        jnp.where(dep_f, length_n - 1, 0))[-1]
     ovf_p = jax.lax.psum(jnp.maximum(total_norm - cap_pairs, 0), AXIS)
     row, partner, pvalid = pairs.emit_pair_indices(pos, length_n, start_idx,
-                                                   cap_pairs)
+                                                   cap_pairs, emit=dep_f)
     pvalid = pvalid & dep_f[row] & ref_f[partner]
 
     # Giant lines: extract whole lines, all_gather, process an owned dep slice.
@@ -549,8 +573,8 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
 
 
 def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
-                 min_support, *, cap_pairs, cap_exchange_c, cap_giant,
-                 cap_giant_pairs, skew=DEFAULT_SKEW):
+                 min_support, pass_idx, n_pass, *, cap_pairs, cap_exchange_c,
+                 cap_giant, cap_giant_pairs, skew=DEFAULT_SKEW):
     """AllAtOnce finish: all-flag pair phase + support join + CIND test."""
     n = jv.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
@@ -558,7 +582,8 @@ def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
      n_giant_pairs, _) = _pair_phase(
         jv, code, v1, v2, n_rows[0], valid, valid, cap_pairs=cap_pairs,
         cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
-        cap_giant_pairs=cap_giant_pairs, skew=skew)
+        cap_giant_pairs=cap_giant_pairs, skew=skew,
+        pass_idx=pass_idx[0], n_pass=n_pass[0])
 
     # Support lookup + CIND test (same-device by shared hash _SEED_CAPTURE).
     tbl_valid = jnp.arange(tc.shape[0], dtype=jnp.int32) < n_caps[0]
@@ -583,15 +608,16 @@ def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
     static_argnames=("mesh", "cap_pairs", "cap_exchange_c", "cap_giant",
                      "cap_giant_pairs", "skew"))
 def _cind_step(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
-               min_support, *, mesh, cap_pairs, cap_exchange_c, cap_giant,
-               cap_giant_pairs, skew=DEFAULT_SKEW):
+               min_support, pass_idx, n_pass, *, mesh, cap_pairs,
+               cap_exchange_c, cap_giant, cap_giant_pairs, skew=DEFAULT_SKEW):
     fn = functools.partial(_cind_device, cap_pairs=cap_pairs,
                            cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
                            cap_giant_pairs=cap_giant_pairs, skew=skew)
     return jax.shard_map(fn, mesh=mesh,
-                         in_specs=(P(AXIS),) * 10 + (P(),),
+                         in_specs=(P(AXIS),) * 10 + (P(),) * 3,
                          out_specs=P(AXIS), check_vma=False)(
-        jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps, min_support)
+        jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps, min_support,
+        pass_idx, n_pass)
 
 
 # ---------------------------------------------------------------------------
@@ -605,6 +631,13 @@ def _cind_step(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
 # the whole pipeline per test workload).
 T_LOC_FLOOR = 256
 CAP_FLOOR = 512
+
+# Per-device pair-stream rows per pass before the pair phase splits into
+# dep-slice passes (RDFIND_PAIR_ROW_BUDGET overrides).  2^25 rows cost
+# ~150-200 B each through emission + the merge lexsort — a few GB of
+# transients, comfortable inside a v5e's 16 GB HBM next to the resident
+# lines; hosts proxying many fake devices in one address space set it lower.
+PAIR_ROW_BUDGET = 1 << 25
 
 
 def _shard_triples(triples, num_dev, t_loc: int | None = None):
@@ -721,15 +754,27 @@ class _Pipeline:
                   f"pairs={int(plan[1])} giant_rows={int(plan[2])} "
                   f"giant_pairs={int(plan[3])}", file=sys.stderr, flush=True)
         self.cap_b = _headroom(plan[0])
-        self.cap_p = _headroom(plan[1], floor=1 << 10)
+        # Bounded-memory streaming: when the measured per-device pair load
+        # exceeds the row budget, the pair phase runs as n_pass dep-slice
+        # passes over the resident join lines, each with ~1/n_pass the
+        # buffers (the windowed-merge intent of BulkMergeDependencies
+        # .scala:96-104, as multi-pass streaming instead of disk spill).
+        budget = int(os.environ.get("RDFIND_PAIR_ROW_BUDGET",
+                                    PAIR_ROW_BUDGET))
+        full_load = int(plan[1]) + 2 * int(plan[3])
+        self.n_pass = max(1, -(-full_load // budget))
+        self.cap_p = _headroom(int(plan[1]) // self.n_pass, floor=1 << 10)
         self.cap_g = _headroom(plan[2])
-        self.cap_gp = _headroom(2 * int(plan[3]), floor=1 << 10)
+        self.cap_gp = _headroom(2 * int(plan[3]) // self.n_pass,
+                                floor=1 << 10)
         # Exchange C per-(src, dst) capacity: the deduped pair partials are
         # hash-spread over dep-capture owners, so the expected per-destination
         # share is (pairs + giant pairs) / D; overflow retries cover skew.
         self.cap_c = _headroom((self.cap_p + self.cap_gp)
                                // max(self.num_dev, 1), floor=1 << 10)
         self._check_pair_caps()
+        if stats is not None:
+            stats["n_pair_passes"] = self.n_pass
 
         # P2b: load-aware placement of the measured hot tail.
         self._maybe_rebalance()
@@ -761,10 +806,11 @@ class _Pipeline:
         LoadBasedPartitioner semantics over measured loads)."""
         if self.num_dev <= 1:
             return
-        hot_jv, hot_len, dev_load = _hotlines_step(self.lines[0], self.n_rows,
-                                                   mesh=self.mesh,
-                                                   skew=self.skew,
-                                                   cap_pairs=self.cap_p)
+        # Full pair budget (all passes), matching the pair phase's effective
+        # giant threshold so both stages share one load model.
+        hot_jv, hot_len, dev_load = _hotlines_step(
+            self.lines[0], self.n_rows, mesh=self.mesh, skew=self.skew,
+            cap_pairs=self.cap_p * self.n_pass)
         hot_jv = host_gather(hot_jv).reshape(self.num_dev, -1)
         hot_len = host_gather(hot_len).reshape(self.num_dev, -1)
         cur = host_gather(dev_load).astype(np.float64)  # (D,) total load
@@ -891,49 +937,68 @@ class _Pipeline:
         order = np.lexsort((cap_v2, cap_v1, cap_code))
         return (cap_code[order], cap_v1[order], cap_v2[order], dep_count[order])
 
+    def _pass_args(self, p: int):
+        return (jnp.full(1, p, jnp.int32), jnp.full(1, self.n_pass, jnp.int32))
+
+    def _run_passes(self, step, what: str):
+        """Dep-slice pass loop with per-pass overflow retries — the shared
+        scaffolding of run_cinds and run_cooc.  `step(pass_args)` must return
+        (cols, n_out, overflow, tail_counters).  Slices partition the
+        dependent captures, so per-pass blocks concatenate directly.
+        Returns (host blocks, tail counters transposed to per-counter
+        tuples of ints)."""
+        parts, tails = [], []
+        for p in range(self.n_pass):
+            for _ in range(self.max_retries):
+                cols, n_out, overflow, tail = step(self._pass_args(p))
+                ovf = host_gather(overflow).reshape(self.num_dev, 4)[0]
+                if int(ovf.sum()) == 0:
+                    break
+                self._grow_pair_caps(ovf)
+            else:
+                raise RuntimeError(
+                    f"{what} overflow persisted after {self.max_retries} "
+                    f"retries ({ovf.tolist()})")
+            parts.append(self.collect_blocks(cols, n_out))
+            tails.append(tuple(int(host_gather(t)[0]) for t in tail))
+        blocks = [np.concatenate([part[i] for part in parts])
+                  for i in range(len(parts[0]))]
+        return blocks, tuple(zip(*tails))
+
     def run_cinds(self):
         """AllAtOnce finish over the device-resident lines."""
-        for _ in range(self.max_retries):
+        def step(pass_args):
             out = _cind_step(*self.lines, self.n_rows, *self.tbl, self.n_caps,
-                             jnp.int32(self.min_support), mesh=self.mesh,
-                             **self._pair_caps())
+                             jnp.int32(self.min_support), *pass_args,
+                             mesh=self.mesh, **self._pair_caps())
             *cols, n_out, overflow, ngl, ngp = out
-            ovf = host_gather(overflow).reshape(self.num_dev, 4)[0]
-            if int(ovf.sum()) == 0:
-                break
-            self._grow_pair_caps(ovf)
-        else:
-            raise RuntimeError(
-                f"pair-phase overflow persisted after {self.max_retries} "
-                f"retries ({ovf.tolist()})")
+            return cols, n_out, overflow, (ngl, ngp)
+
+        blocks, (ngl, ngp) = self._run_passes(step, "pair-phase")
         if self.stats is not None:
-            self.stats["n_giant_lines"] = int(host_gather(ngl)[0])
-            self.stats["n_giant_pairs"] = int(host_gather(ngp)[0])
-        return self.collect_blocks(cols, n_out)
+            self.stats["n_giant_lines"] = ngl[-1]
+            self.stats["n_giant_pairs"] = sum(ngp)
+        return blocks
 
     def run_cooc(self, fcode, fv1, fv2, fflag, n_flags, stat_key):
         """S2L level verification over the device-resident lines."""
-        for _ in range(self.max_retries):
+        def step(pass_args):
             out = _s2l_cooc(*self.lines, self.n_rows, fcode, fv1, fv2, fflag,
-                            n_flags, mesh=self.mesh, **self._pair_caps())
+                            n_flags, *pass_args, mesh=self.mesh,
+                            **self._pair_caps())
             *cols, n_out, overflow, ngl, ngp, npt = out
-            ovf = host_gather(overflow).reshape(self.num_dev, 4)[0]
-            if int(ovf.sum()) == 0:
-                break
-            self._grow_pair_caps(ovf)
-        else:
-            raise RuntimeError(
-                f"sharded S2L cooc overflow persisted after "
-                f"{self.max_retries} retries ({ovf.tolist()})")
+            return cols, n_out, overflow, (ngl, ngp, npt)
+
+        blocks, (ngl, ngp, npt) = self._run_passes(step, "sharded S2L cooc")
         if self.stats is not None:
-            npt = int(host_gather(npt)[0])
-            self.stats[stat_key] = npt
-            self.stats["total_pairs"] = self.stats.get("total_pairs", 0) + npt
+            self.stats[stat_key] = sum(npt)
+            self.stats["total_pairs"] = (self.stats.get("total_pairs", 0)
+                                         + sum(npt))
             self.stats["n_giant_lines"] = max(
-                self.stats.get("n_giant_lines", 0), int(host_gather(ngl)[0]))
+                self.stats.get("n_giant_lines", 0), ngl[-1])
             self.stats["n_giant_pairs"] = (
-                self.stats.get("n_giant_pairs", 0) + int(host_gather(ngp)[0]))
-        return self.collect_blocks(cols, n_out)
+                self.stats.get("n_giant_pairs", 0) + sum(ngp))
+        return blocks
 
 
 def discover_sharded(triples, min_support: int, mesh=None, projections: str = "spo",
@@ -992,8 +1057,8 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
 
 
 def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
-                     *, cap_pairs, cap_exchange_c, cap_giant, cap_giant_pairs,
-                     skew=DEFAULT_SKEW):
+                     pass_idx, n_pass, *, cap_pairs, cap_exchange_c, cap_giant,
+                     cap_giant_pairs, skew=DEFAULT_SKEW):
     """One level's verification: join flags onto rows, masked pair phase."""
     n = jv.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
@@ -1012,7 +1077,8 @@ def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
      n_giant_pairs, n_pairs_total) = _pair_phase(
         jv2, code2, v12, v22, n_keep, df2, rf2, cap_pairs=cap_pairs,
         cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
-        cap_giant_pairs=cap_giant_pairs, skew=skew)
+        cap_giant_pairs=cap_giant_pairs, skew=skew,
+        pass_idx=pass_idx[0], n_pass=n_pass[0])
     out_cols, n_out = segments.compact(list(ucols) + [cooc], uvalid)
     overflow = jnp.stack([ovf_p, ovf_c, ovf_g, ovf_gp])
     return (*out_cols, jnp.full(1, n_out, jnp.int32), overflow,
@@ -1025,18 +1091,19 @@ def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
     jax.jit,
     static_argnames=("mesh", "cap_pairs", "cap_exchange_c", "cap_giant",
                      "cap_giant_pairs", "skew"))
-def _s2l_cooc(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags, *,
-              mesh, cap_pairs, cap_exchange_c, cap_giant, cap_giant_pairs,
-              skew=DEFAULT_SKEW):
+def _s2l_cooc(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
+              pass_idx, n_pass, *, mesh, cap_pairs, cap_exchange_c, cap_giant,
+              cap_giant_pairs, skew=DEFAULT_SKEW):
     fn = functools.partial(
         _s2l_cooc_device, cap_pairs=cap_pairs, cap_exchange_c=cap_exchange_c,
         cap_giant=cap_giant, cap_giant_pairs=cap_giant_pairs, skew=skew)
     return jax.shard_map(
         fn, mesh=mesh,
-        in_specs=(P(AXIS),) * 5 + (P(),) * 5,
+        in_specs=(P(AXIS),) * 5 + (P(),) * 7,
         out_specs=P(AXIS),
         check_vma=False,
-    )(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags)
+    )(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
+      pass_idx, n_pass)
 
 
 class _ShardedCooc:

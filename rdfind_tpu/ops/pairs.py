@@ -75,7 +75,7 @@ def line_layout(line_val, n_valid):
 
 
 def emit_pair_indices(pos, length, start_idx, capacity: int,
-                      balanced: bool = False):
+                      balanced: bool = False, emit=None):
     """Row/partner indices of all ordered co-occurrence pairs, statically padded.
 
     Returns (row, partner, pair_valid): gather payload columns at `row` (dependent)
@@ -83,6 +83,13 @@ def emit_pair_indices(pos, length, start_idx, capacity: int,
     garbage (masked by pair_valid).  If total pairs exceed `capacity`, the excess is
     truncated — callers must compare line_layout's total against capacity and
     retry/chunk on overflow.
+
+    `emit` (optional bool per row) suppresses emission for rows where it is
+    False: those rows take ZERO output slots (they still appear as partners of
+    emitting rows).  This is what makes dependent-side restriction reduce the
+    required capacity — a masked-after-emission design would still allocate
+    the full quadratic — and it is the mechanism behind both the S2L level
+    masks and the bounded-memory dep-slice pair passes.
 
     balanced=True emits each *unordered* pair exactly once — rotations
     j <= (L-1)//2 per row, plus (for even L) the antipodal rotation L/2 for the
@@ -99,6 +106,8 @@ def emit_pair_indices(pos, length, start_idx, capacity: int,
         reps = reps.astype(jnp.int32)
     else:
         reps = length - 1
+    if emit is not None:
+        reps = jnp.where(emit, reps, 0)
     # Saturating prefix sum instead of jnp.repeat's internal cumsum: immune to int32
     # wrap on quadratic totals (see saturating_cumsum).
     cum = saturating_cumsum(reps)

@@ -465,3 +465,18 @@ def test_join_histogram_sharded_matches_host(mesh8):
     got = sharded.join_histogram_sharded(g_triples, g_valid, "spo", mesh8)
     want = _join_histogram(ids, "spo")
     assert got == want
+
+
+def test_sharded_multipass_pair_phase(mesh8, monkeypatch):
+    """A tiny pair-row budget must force dep-slice streaming passes (the
+    bounded-memory pair phase) on BOTH strategies, with identical output."""
+    triples = generate_triples(300, seed=21, n_predicates=8, n_entities=32)
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 10)
+    s0, s1 = {}, {}
+    a = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=s0)
+    b = sharded.discover_sharded_s2l(triples, 2, mesh=mesh8, stats=s1)
+    assert s0["n_pair_passes"] > 1
+    assert s1["n_pair_passes"] > 1
+    want = allatonce.discover(triples, 2)
+    assert a.to_rows() == want.to_rows()
+    assert b.to_rows() == small_to_large.discover(triples, 2).to_rows()
