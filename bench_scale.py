@@ -17,7 +17,8 @@ honest headline is the MEMORY + correctness bound (per-host RSS vs dataset
 size), with wall-clock reported as-is.
 
 Run:  python bench_scale.py [--n 20000000] [--support 1000] [--strategies 0,1]
-Output: one JSON line per (strategy) run -> append to SCALE_r04.jsonl.
+Output: one JSON line per (strategy) run -> append to SCALE_r05.jsonl.
+RDFIND_PAIR_ROW_BUDGET bounds per-device pair buffers (dep-slice streaming).
 """
 
 import argparse
@@ -160,7 +161,8 @@ def main():
         row = {"n_triples": args.n, "support": args.support,
                "strategy": strat, "wall_s": round(wall, 1),
                "datagen_s": round(gen_s, 1), "hosts": 2,
-               "box": "1 CPU core, 4 fake devices/host"}
+               "box": "1 CPU core, 4 fake devices/host",
+               "pair_row_budget": os.environ.get("RDFIND_PAIR_ROW_BUDGET")}
         for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
             rep = parse_report(err)
             row[f"host{pid}"] = {
@@ -172,7 +174,7 @@ def main():
             if p.returncode != 0:
                 row[f"host{pid}"]["stderr_tail"] = err[-1500:]
         print(json.dumps(row), flush=True)
-        with open(os.path.join(REPO, "SCALE_r04.jsonl"), "a") as f:
+        with open(os.path.join(REPO, "SCALE_r05.jsonl"), "a") as f:
             f.write(json.dumps(row) + "\n")
 
     if not args.keep_data:
