@@ -299,6 +299,20 @@ def kernel_selfcheck(n_rows: int = 1024, n_bits: int = 4096,
 
     result = {"parity": parity, "n_rows": n_rows, "bits": n_bits,
               "backend": backend}
+    # HBM traffic model of the packed kernel (ops/pallas_kernels.py grid):
+    # each packed operand tile is re-read once per opposite-side tile, plus
+    # the uint8 output write — the measured-bandwidth denominator for the
+    # roofline (VERDICT r4 item 7: is 0.27% dense-peak actually BW-bound?).
+    w = n_bits // 32
+    from . import pallas_kernels as pk
+    # Padded dims: contains_matrix pads both operands to tile multiples, so
+    # real traffic scales with the padded shapes.
+    d_pad = -(-n_rows // pk.TILE_D) * pk.TILE_D
+    r_pad = -(-n_rows // pk.TILE_R) * pk.TILE_R
+    result["hbm_bytes_model"] = int(
+        (r_pad // pk.TILE_R) * d_pad * w * 4        # dep side re-reads
+        + (d_pad // pk.TILE_D) * r_pad * w * 4      # ref side re-reads
+        + d_pad * r_pad)                            # uint8 output
     if on_tpu:
         # Timing methodology: each repeat uses a *different* input (salted ids)
         # and the loop is drained by one scalar readback at the end — identical
@@ -317,6 +331,30 @@ def kernel_selfcheck(n_rows: int = 1024, n_bits: int = 4096,
             int(acc)  # forces the whole chain to finish
             result[name] = round((_time.perf_counter() - t0) / repeats * 1e3, 3)
         result["speedup"] = round(result["jnp_ms"] / result["pallas_ms"], 3)
+        # Kernel-only bandwidth: refs pre-packed outside the timed loop (the
+        # end-to-end pallas_ms above keeps packing for a fair jnp speedup
+        # comparison) and the drain's n^2 uint8 read added to the model, so
+        # pallas_gbps reflects the kernel's real HBM rate.
+        packs = [pack_ref_bits(ref_ids + (i + 1), bits=n_bits,
+                               num_hashes=num_hashes) for i in range(repeats)]
+        jax.block_until_ready(packs)
+        int(contains_matrix(sketches, ref_ids - 1, ref_valid, bits=n_bits,
+                            num_hashes=num_hashes, backend="pallas",
+                            ref_pack=packs[0]).sum())  # warm this variant
+        t0 = _time.perf_counter()
+        acc = None
+        for i in range(repeats):
+            out = contains_matrix(sketches, ref_ids + (i + 1), ref_valid,
+                                  bits=n_bits, num_hashes=num_hashes,
+                                  backend="pallas", ref_pack=packs[i])
+            s = out.sum()
+            acc = s if acc is None else acc + s
+        int(acc)
+        kernel_ms = (_time.perf_counter() - t0) / repeats * 1e3
+        result["pallas_kernel_ms"] = round(kernel_ms, 3)
+        result["pallas_gbps"] = round(
+            (result["hbm_bytes_model"] + d_pad * r_pad)
+            / (kernel_ms / 1e3) / 1e9, 1)
     return result
 
 

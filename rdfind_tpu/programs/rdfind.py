@@ -111,7 +111,11 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument(flag, type=int, default=dv, help=argparse.SUPPRESS)
     p.add_argument("--explicit-threshold", type=int, default=-1,
                    help="half-approximate 1/1 round: max exact per-dependent "
-                        "counters (strategy 1; -1 = exact overlaps)")
+                        "counters (strategy 1, single-device; -1 = exact "
+                        "overlaps).  Sharded runs keep exact one-pass 1/1 by "
+                        "policy: planned capacities + dep-slice streaming "
+                        "(RDFIND_PAIR_ROW_BUDGET) already give the spectral "
+                        "round's memory bound")
     p.add_argument("--sbf-bytes", type=int, default=-1, dest="sbf_bits",
                    help="bits per spectral (count-min) counter for the "
                         "half-approximate round (-1 = sized to support)")
