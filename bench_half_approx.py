@@ -157,7 +157,10 @@ def main():
         "wall_ratio_half_approx_over_sharded": round(wall_a / wall_b, 3),
         "memory_ratio_half_approx_over_sharded_per_device":
             round(bytes_a / max(bytes_b, 1), 3),
-        "n_triples": args.n, "min_support": args.support,
+        "n_triples": args.n, "n_triples_actual": int(len(triples)),
+        "hub": args.hub, "min_support": args.support,
+        "n_pair_passes": int(sb.get("n_pair_passes", 1)),
+        "n_giant_lines": int(sb.get("n_giant_lines", 0)),
     }
     print(json.dumps(cmp_row), flush=True)
     if not same:

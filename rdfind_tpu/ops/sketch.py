@@ -338,9 +338,14 @@ def kernel_selfcheck(n_rows: int = 1024, n_bits: int = 4096,
         packs = [pack_ref_bits(ref_ids + (i + 1), bits=n_bits,
                                num_hashes=num_hashes) for i in range(repeats)]
         jax.block_until_ready(packs)
+        # Warm with a DISTINCT pack: a warm dispatch identical to timed
+        # iteration 0 would let the runtime streamline it (the r2 artifact
+        # the salted loop above exists to avoid).
+        warm_pack = pack_ref_bits(ref_ids - 1, bits=n_bits,
+                                  num_hashes=num_hashes)
         int(contains_matrix(sketches, ref_ids - 1, ref_valid, bits=n_bits,
                             num_hashes=num_hashes, backend="pallas",
-                            ref_pack=packs[0]).sum())  # warm this variant
+                            ref_pack=warm_pack).sum())
         t0 = _time.perf_counter()
         acc = None
         for i in range(repeats):
