@@ -471,7 +471,9 @@ def test_sharded_multipass_pair_phase(mesh8, monkeypatch):
     """A tiny pair-row budget must force dep-slice streaming passes (the
     bounded-memory pair phase) on BOTH strategies, with identical output."""
     triples = generate_triples(300, seed=21, n_predicates=8, n_entities=32)
-    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 10)
+    # 2^13 rows => 2-3 passes (enough to exercise slicing without tens of
+    # per-pass dispatches dominating the fast tier).
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
     s0, s1 = {}, {}
     a = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=s0)
     b = sharded.discover_sharded_s2l(triples, 2, mesh=mesh8, stats=s1)
