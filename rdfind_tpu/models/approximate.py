@@ -213,10 +213,20 @@ def _dense_verify_counts(line_val_h, line_cap_h, num_caps, cand_dep, cand_ref,
         jnp.arange(row_cap, dtype=jnp.int32) < n, l_pad=l_pad, c_pad=c_pad)
 
     # Candidates grouped by dep tile (defensive sort: _candidate_pairs emits
-    # dep-ascending, but the contract here is order-insensitive).
+    # dep-ascending, but the contract here is order-insensitive).  All tile
+    # gathers are dispatched first and pulled in ONE device_get — per-tile
+    # pulls cost one host round trip each over the tunnel (r5).
     order = np.argsort(cand_dep, kind="stable")
     d_sorted, r_sorted = cand_dep[order], cand_ref[order]
     cnt_sorted = np.zeros(len(cand_dep), np.int64)
+    spans, pulls, pend_bytes = [], [], 0
+
+    def drain():
+        nonlocal spans, pulls, pend_bytes
+        for (a, b), got in zip(spans, jax.device_get(pulls)):
+            cnt_sorted[a:b] = got[:b - a]
+        spans, pulls, pend_bytes = [], [], 0
+
     for lo in range(0, num_caps, tile):
         a = np.searchsorted(d_sorted, lo)
         b = np.searchsorted(d_sorted, lo + tile)
@@ -224,12 +234,19 @@ def _dense_verify_counts(line_val_h, line_cap_h, num_caps, cand_dep, cand_ref,
             continue
         k = b - a
         k_cap = segments.pow2_capacity(k)
-        got = _stage_tile_counts(
+        spans.append((a, b))
+        pulls.append(_stage_tile_counts(
             m, jnp.int32(lo),
             jnp.asarray(pad((d_sorted[a:b] - lo).astype(np.int32), k_cap, 0)),
             jnp.asarray(pad(r_sorted[a:b].astype(np.int32), k_cap, 0)),
-            jnp.arange(k_cap, dtype=jnp.int32) < k, tile=tile)
-        cnt_sorted[a:b] = np.asarray(got)[:k]
+            jnp.arange(k_cap, dtype=jnp.int32) < k, tile=tile))
+        # Pending tiles pin padded inputs + outputs on device (~13 bytes per
+        # slot); drain under the shared pull budget so huge candidate sets
+        # cannot stack GB of buffers next to the near-budget matrix `m`.
+        pend_bytes += 13 * k_cap
+        if pend_bytes >= cooc_ops.PULL_BYTES_BUDGET:
+            drain()
+    drain()
     cnt = np.empty_like(cnt_sorted)
     cnt[order] = cnt_sorted
     return cnt
