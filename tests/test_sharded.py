@@ -482,3 +482,19 @@ def test_sharded_multipass_pair_phase(mesh8, monkeypatch):
     want = allatonce.discover(triples, 2)
     assert a.to_rows() == want.to_rows()
     assert b.to_rows() == small_to_large.discover(triples, 2).to_rows()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [31, 37, 41])
+def test_sharded_multipass_fuzz(mesh8, monkeypatch, seed):
+    """Streaming passes stay exact across random workloads (slow tier):
+    every strategy-0 run with a tiny budget must equal the single-chip
+    oracle regardless of how the dep slices cut the capture space."""
+    rng = random.Random(seed)
+    ids, _ = intern_triples(np.asarray(
+        random_triples(rng, 250, 10, 4, 8), dtype=object))
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 12)
+    s: dict = {}
+    a = sharded.discover_sharded(ids, 2, mesh=mesh8, stats=s)
+    assert s["n_pair_passes"] > 1
+    assert a.to_rows() == allatonce.discover(ids, 2).to_rows()
