@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from ..data import CindTable
 from ..ops import cooc as cooc_ops
 from ..ops import frequency, minimality, segments, sketch
+from ..runtime import dispatch
 from . import allatonce, small_to_large
 
 DEP_TILE = 1 << 12
@@ -240,6 +241,10 @@ def _dense_verify_counts(line_val_h, line_cap_h, num_caps, cand_dep, cand_ref,
             jnp.asarray(pad((d_sorted[a:b] - lo).astype(np.int32), k_cap, 0)),
             jnp.asarray(pad(r_sorted[a:b].astype(np.int32), k_cap, 0)),
             jnp.arange(k_cap, dtype=jnp.int32) < k, tile=tile))
+        # Start the device->host copy the moment the gather is enqueued: the
+        # drain's batched device_get then mostly finds the counts already on
+        # host while later tiles' matmuls are still computing.
+        dispatch.stage_to_host(pulls[-1:])
         # Pending tiles pin padded inputs + outputs on device (~13 bytes per
         # slot); drain under the shared pull budget so huge candidate sets
         # cannot stack GB of buffers next to the near-budget matrix `m`.

@@ -66,6 +66,7 @@ needed, because every grouping is a hash-bucketed sort on the owning device.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import os
@@ -81,7 +82,9 @@ from ..data import CindTable
 from ..ops import frequency, hashing, minimality, pairs, segments
 from ..ops.emission import emit_join_candidates
 from ..parallel import exchange
-from ..parallel.mesh import AXIS, host_gather, make_global, make_mesh
+from ..parallel.mesh import (AXIS, host_gather, host_gather_many, make_global,
+                             make_mesh, shard_map)
+from ..runtime import dispatch
 
 SENTINEL = segments.SENTINEL
 
@@ -236,8 +239,8 @@ def _plan_device(triples, n_valid, *, projections, use_fis, combine=True):
 def _plan_step(triples, n_valid, *, mesh, projections, use_fis, combine=True):
     fn = functools.partial(_plan_device, projections=projections,
                            use_fis=use_fis, combine=combine)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS)),
-                         out_specs=P(AXIS), check_vma=False)(triples, n_valid)
+    return shard_map(fn, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS)),
+                     out_specs=P(AXIS), check_vma=False)(triples, n_valid)
 
 
 # ---------------------------------------------------------------------------
@@ -317,9 +320,9 @@ def _lines_step(triples, n_valid, min_support, *, mesh, projections, use_fis,
                            use_fis=use_fis, use_ars=use_ars, cap_freq=cap_freq,
                            cap_exchange_a=cap_exchange_a, skew=skew,
                            combine=combine)
-    return jax.shard_map(fn, mesh=mesh,
-                         in_specs=(P(AXIS, None), P(AXIS), P()),
-                         out_specs=P(AXIS), check_vma=False)(
+    return shard_map(fn, mesh=mesh,
+                     in_specs=(P(AXIS, None), P(AXIS), P()),
+                     out_specs=P(AXIS), check_vma=False)(
         triples, n_valid, min_support)
 
 
@@ -376,8 +379,8 @@ def _hotlines_device(jv, n_rows, *, skew=DEFAULT_SKEW, cap_pairs=None):
 @functools.partial(jax.jit, static_argnames=("mesh", "skew", "cap_pairs"))
 def _hotlines_step(jv, n_rows, *, mesh, skew=DEFAULT_SKEW, cap_pairs=None):
     fn = functools.partial(_hotlines_device, skew=skew, cap_pairs=cap_pairs)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(P(AXIS),) * 2,
-                         out_specs=P(AXIS), check_vma=False)(jv, n_rows)
+    return shard_map(fn, mesh=mesh, in_specs=(P(AXIS),) * 2,
+                     out_specs=P(AXIS), check_vma=False)(jv, n_rows)
 
 
 def _rebalance_device(jv, code, v1, v2, n_rows, moved_jv, moved_dest, *,
@@ -405,9 +408,9 @@ def _rebalance_device(jv, code, v1, v2, n_rows, moved_jv, moved_dest, *,
 def _rebalance_step(jv, code, v1, v2, n_rows, moved_jv, moved_dest, *, mesh,
                     cap_move):
     fn = functools.partial(_rebalance_device, cap_move=cap_move)
-    return jax.shard_map(fn, mesh=mesh,
-                         in_specs=(P(AXIS),) * 5 + (P(), P()),
-                         out_specs=P(AXIS), check_vma=False)(
+    return shard_map(fn, mesh=mesh,
+                     in_specs=(P(AXIS),) * 5 + (P(), P()),
+                     out_specs=P(AXIS), check_vma=False)(
         jv, code, v1, v2, n_rows, moved_jv, moved_dest)
 
 
@@ -433,9 +436,9 @@ def _captures_device(jv, code, v1, v2, n_rows, *, cap_exchange_b):
 @functools.partial(jax.jit, static_argnames=("mesh", "cap_exchange_b"))
 def _captures_step(jv, code, v1, v2, n_rows, *, mesh, cap_exchange_b):
     fn = functools.partial(_captures_device, cap_exchange_b=cap_exchange_b)
-    return jax.shard_map(fn, mesh=mesh,
-                         in_specs=(P(AXIS),) * 5,
-                         out_specs=P(AXIS), check_vma=False)(
+    return shard_map(fn, mesh=mesh,
+                     in_specs=(P(AXIS),) * 5,
+                     out_specs=P(AXIS), check_vma=False)(
         jv, code, v1, v2, n_rows)
 
 
@@ -572,6 +575,15 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
             n_giant_lines, n_giant_pairs, n_pairs_total)
 
 
+# Packed per-pass control lanes (exchange.pack_counters): 4 overflow counters
+# followed by the tail counters.  ONE lane array per pass is the whole
+# device->host control surface of the pipelined executor — the host reads it
+# in a single async-staged pull instead of 3+ blocking host_gathers.
+_TELE_LANES = 7  # [ovf_p, ovf_c, ovf_g, ovf_gp, n_giant_lines, n_giant_pairs,
+#                  n_pairs_total]
+_N_OVF = 4
+
+
 def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
                  min_support, pass_idx, n_pass, *, cap_pairs, cap_exchange_c,
                  cap_giant, cap_giant_pairs, skew=DEFAULT_SKEW):
@@ -579,7 +591,7 @@ def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
     n = jv.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
     (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp), n_giant_lines,
-     n_giant_pairs, _) = _pair_phase(
+     n_giant_pairs, n_pairs_total) = _pair_phase(
         jv, code, v1, v2, n_rows[0], valid, valid, cap_pairs=cap_pairs,
         cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
         cap_giant_pairs=cap_giant_pairs, skew=skew,
@@ -597,10 +609,9 @@ def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
     keep = is_cind & ~implied
 
     out_cols, n_out = segments.compact(list(ucols) + [dep_count], keep)
-    overflow = jnp.stack([ovf_p, ovf_c, ovf_g, ovf_gp])
-    return (*out_cols, jnp.full(1, n_out, jnp.int32), overflow,
-            jnp.full(1, n_giant_lines, jnp.int32),
-            jnp.full(1, n_giant_pairs, jnp.int32))
+    tele = exchange.pack_counters([ovf_p, ovf_c, ovf_g, ovf_gp, n_giant_lines,
+                                   n_giant_pairs, n_pairs_total])
+    return (*out_cols, jnp.full(1, n_out, jnp.int32), tele)
 
 
 @functools.partial(
@@ -613,9 +624,9 @@ def _cind_step(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
     fn = functools.partial(_cind_device, cap_pairs=cap_pairs,
                            cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
                            cap_giant_pairs=cap_giant_pairs, skew=skew)
-    return jax.shard_map(fn, mesh=mesh,
-                         in_specs=(P(AXIS),) * 10 + (P(),) * 3,
-                         out_specs=P(AXIS), check_vma=False)(
+    return shard_map(fn, mesh=mesh,
+                     in_specs=(P(AXIS),) * 10 + (P(),) * 3,
+                     out_specs=P(AXIS), check_vma=False)(
         jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps, min_support,
         pass_idx, n_pass)
 
@@ -899,14 +910,13 @@ class _Pipeline:
                     giant_gather=self.num_dev * self.cap_g)
 
     def collect_blocks(self, cols, n_out):
-        """Per-device compacted outputs -> host rows."""
-        cols = [host_gather(c) for c in cols]
-        n_out = host_gather(n_out)
-        block = cols[0].shape[0] // self.num_dev
-        keep = np.zeros(cols[0].shape[0], bool)
+        """Per-device compacted outputs -> host rows (ONE batched pull)."""
+        *cols_h, n_out_h = host_gather_many(list(cols) + [n_out])
+        block = cols_h[0].shape[0] // self.num_dev
+        keep = np.zeros(cols_h[0].shape[0], bool)
         for dev in range(self.num_dev):
-            keep[dev * block: dev * block + int(n_out[dev])] = True
-        return [c[keep] for c in cols]
+            keep[dev * block: dev * block + int(n_out_h[dev])] = True
+        return [c[keep] for c in cols_h]
 
     def capture_table(self):
         """Host capture table in canonical (code, v1, v2) order.  Each distinct
@@ -941,29 +951,75 @@ class _Pipeline:
         return (jnp.full(1, p, jnp.int32), jnp.full(1, self.n_pass, jnp.int32))
 
     def _run_passes(self, step, what: str):
-        """Dep-slice pass loop with per-pass overflow retries — the shared
-        scaffolding of run_cinds and run_cooc.  `step(pass_args)` must return
-        (cols, n_out, overflow, tail_counters).  Slices partition the
-        dependent captures, so per-pass blocks concatenate directly.
-        Returns (host blocks, tail counters transposed to per-counter
-        tuples of ints)."""
-        parts, tails = [], []
-        for p in range(self.n_pass):
-            for _ in range(self.max_retries):
-                cols, n_out, overflow, tail = step(self._pass_args(p))
-                ovf = host_gather(overflow).reshape(self.num_dev, 4)[0]
-                if int(ovf.sum()) == 0:
-                    break
+        """Pipelined dep-slice pass executor — the shared scaffolding of
+        run_cinds and run_cooc.  `step(pass_args)` must return device arrays
+        (cols, n_out, telemetry) with telemetry an exchange.pack_counters
+        lane array of _TELE_LANES scalars whose first _N_OVF lanes are the
+        overflow counters.
+
+        Schedule: pass p+1's jitted step is enqueued as soon as pass p's is
+        (up to dispatch.pass_depth() passes in flight), the packed telemetry
+        of the head pass is staged to host asynchronously, and the head's
+        block pull (collect_blocks) runs while its successors compute — so a
+        clean pass costs exactly TWO host round trips (one control pull, one
+        batched data pull), both overlapped with enqueued device work, versus
+        the 3+ serial blocking host_gathers of the pre-pipelined loop.
+
+        Optimistic dispatch: successors are enqueued before the head's
+        overflow verdict is known.  On overflow the in-flight successors are
+        DISCARDED (their programs finish on device; the results are simply
+        never read), capacities grow, and execution resumes from the failed
+        pass — completed passes are never re-run.  The rollback is sound
+        because passes only read the immutable device-resident lines/table
+        and partition the dependent captures, so a discarded successor has no
+        side effects and its re-run under larger caps emits the same exact
+        counts.  RDFIND_SYNC_PASSES=1 forces the serial schedule (depth 1,
+        identical output by construction — differentially tested).
+
+        Slices partition the dependent captures, so per-pass blocks
+        concatenate directly.  Returns (host blocks, tail counters transposed
+        to per-counter tuples of ints); publishes dispatch telemetry into
+        self.stats."""
+        d = dispatch.DispatchStats()
+        parts = [None] * self.n_pass
+        teles = [None] * self.n_pass
+        tries = [0] * self.n_pass
+        depth = dispatch.pass_depth()
+        inflight = collections.deque()  # (p, cols, n_out, telemetry)
+        p_next = 0
+        while p_next < self.n_pass or inflight:
+            while p_next < self.n_pass and len(inflight) < depth:
+                cols, n_out, tele = step(self._pass_args(p_next))
+                dispatch.stage_to_host([tele])
+                inflight.append((p_next, cols, n_out, tele))
+                p_next += 1
+            d.saw_in_flight(len(inflight))
+            p, cols, n_out, tele = inflight.popleft()
+            tele_h = d.timed_pull(
+                lambda: exchange.unpack_counters(host_gather(tele),
+                                                 _TELE_LANES, self.num_dev),
+                overlapped=bool(inflight))
+            ovf = tele_h[:_N_OVF]
+            if int(ovf.sum()) != 0:
+                tries[p] += 1
+                if tries[p] >= self.max_retries:
+                    raise RuntimeError(
+                        f"{what} overflow persisted after {self.max_retries} "
+                        f"retries ({ovf.tolist()})")
+                inflight.clear()  # discard optimistically dispatched successors
                 self._grow_pair_caps(ovf)
-            else:
-                raise RuntimeError(
-                    f"{what} overflow persisted after {self.max_retries} "
-                    f"retries ({ovf.tolist()})")
-            parts.append(self.collect_blocks(cols, n_out))
-            tails.append(tuple(int(host_gather(t)[0]) for t in tail))
+                d.n_cap_retries += 1
+                p_next = p  # resume from the failed pass only
+                continue
+            parts[p] = d.timed_pull(lambda: self.collect_blocks(cols, n_out),
+                                    overlapped=bool(inflight))
+            teles[p] = tuple(int(x) for x in tele_h[_N_OVF:])
         blocks = [np.concatenate([part[i] for part in parts])
                   for i in range(len(parts[0]))]
-        return blocks, tuple(zip(*tails))
+        if self.stats is not None:
+            d.publish(self.stats)
+            self.stats["cap_p_final"] = self.cap_p
+        return blocks, tuple(zip(*teles))
 
     def run_cinds(self):
         """AllAtOnce finish over the device-resident lines."""
@@ -971,12 +1027,15 @@ class _Pipeline:
             out = _cind_step(*self.lines, self.n_rows, *self.tbl, self.n_caps,
                              jnp.int32(self.min_support), *pass_args,
                              mesh=self.mesh, **self._pair_caps())
-            *cols, n_out, overflow, ngl, ngp = out
-            return cols, n_out, overflow, (ngl, ngp)
+            *cols, n_out, tele = out
+            return cols, n_out, tele
 
-        blocks, (ngl, ngp) = self._run_passes(step, "pair-phase")
+        blocks, (ngl, ngp, _) = self._run_passes(step, "pair-phase")
         if self.stats is not None:
-            self.stats["n_giant_lines"] = ngl[-1]
+            # max across passes: a mid-run cap_p growth shifts the giant
+            # threshold between passes, so the last pass may see fewer giants
+            # than an earlier one (ADVICE r5).
+            self.stats["n_giant_lines"] = max(ngl)
             self.stats["n_giant_pairs"] = sum(ngp)
         return blocks
 
@@ -986,8 +1045,8 @@ class _Pipeline:
             out = _s2l_cooc(*self.lines, self.n_rows, fcode, fv1, fv2, fflag,
                             n_flags, *pass_args, mesh=self.mesh,
                             **self._pair_caps())
-            *cols, n_out, overflow, ngl, ngp, npt = out
-            return cols, n_out, overflow, (ngl, ngp, npt)
+            *cols, n_out, tele = out
+            return cols, n_out, tele
 
         blocks, (ngl, ngp, npt) = self._run_passes(step, "sharded S2L cooc")
         if self.stats is not None:
@@ -995,7 +1054,7 @@ class _Pipeline:
             self.stats["total_pairs"] = (self.stats.get("total_pairs", 0)
                                          + sum(npt))
             self.stats["n_giant_lines"] = max(
-                self.stats.get("n_giant_lines", 0), ngl[-1])
+                self.stats.get("n_giant_lines", 0), max(ngl))
             self.stats["n_giant_pairs"] = (
                 self.stats.get("n_giant_pairs", 0) + sum(ngp))
         return blocks
@@ -1080,11 +1139,9 @@ def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
         cap_giant_pairs=cap_giant_pairs, skew=skew,
         pass_idx=pass_idx[0], n_pass=n_pass[0])
     out_cols, n_out = segments.compact(list(ucols) + [cooc], uvalid)
-    overflow = jnp.stack([ovf_p, ovf_c, ovf_g, ovf_gp])
-    return (*out_cols, jnp.full(1, n_out, jnp.int32), overflow,
-            jnp.full(1, n_giant_lines, jnp.int32),
-            jnp.full(1, n_giant_pairs, jnp.int32),
-            jnp.full(1, n_pairs_total, jnp.int32))
+    tele = exchange.pack_counters([ovf_p, ovf_c, ovf_g, ovf_gp, n_giant_lines,
+                                   n_giant_pairs, n_pairs_total])
+    return (*out_cols, jnp.full(1, n_out, jnp.int32), tele)
 
 
 @functools.partial(
@@ -1097,7 +1154,7 @@ def _s2l_cooc(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
     fn = functools.partial(
         _s2l_cooc_device, cap_pairs=cap_pairs, cap_exchange_c=cap_exchange_c,
         cap_giant=cap_giant, cap_giant_pairs=cap_giant_pairs, skew=skew)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh,
         in_specs=(P(AXIS),) * 5 + (P(),) * 7,
         out_specs=P(AXIS),
@@ -1177,7 +1234,7 @@ def _sketch_step_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, n_caps, *,
         num_caps=c_pad, bits=bits)
     planes = jax.lax.pmin(sketch.unpack_planes(partial), AXIS)
 
-    num_dev = jax.lax.axis_size(AXIS)
+    num_dev = jax.lax.psum(1, AXIS)  # axis_size is missing from older jax
     block = c_pad // num_dev
     dep_lo = jax.lax.axis_index(AXIS) * block
     own = jax.lax.dynamic_slice(sketch.pack_planes(planes), (dep_lo, 0),
@@ -1200,7 +1257,7 @@ def _sketch_step(jv, code, v1, v2, n_rows, tc, tv1, tv2, n_caps, *, mesh,
                  c_pad, bits, num_hashes):
     fn = functools.partial(_sketch_step_device, c_pad=c_pad, bits=bits,
                            num_hashes=num_hashes)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh,
         in_specs=(P(AXIS),) * 5 + (P(),) * 4,
         out_specs=P(AXIS),
@@ -1485,7 +1542,7 @@ def _stage_count_fcs(mesh, capacity: int, include_binary: bool):
             ovf_total += ovf
         return jnp.stack(counts), ovf_total
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         f, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS), P()),
         out_specs=(P(), P())))
 
@@ -1513,7 +1570,7 @@ def _stage_join_histogram(mesh, capacity: int, projections: str):
         is_rep = segments.run_starts([r_cols[0]]) & r_valid
         return jnp.where(is_rep, sizes, 0), ovf
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         f, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS)),
         out_specs=(P(AXIS), P())))
 
@@ -1583,7 +1640,7 @@ def _stage_mine_ars(mesh, cap_counts: int, cap_rules: int):
         # route buffers are the scarce resource here).
         return (*r_cols, r_valid, ovf, o_r)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         f, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS), P()),
         out_specs=(*([P(AXIS)] * 6), P(), P())))
 
@@ -1672,7 +1729,7 @@ def _stage_dedupe_preshard(mesh, capacity: int):
         out = jnp.stack(u_cols[:3], axis=1)[:t_loc]
         return out, n_u.reshape(1), ovf
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         f, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS)),
         out_specs=(P(AXIS, None), P(AXIS), P())))
 

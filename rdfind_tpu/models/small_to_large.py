@@ -48,6 +48,7 @@ from .. import conditions as cc
 from ..data import NO_VALUE, CindTable
 from ..ops import cooc as cooc_ops
 from ..ops import frequency, minimality, pairs, segments, sketch
+from ..runtime import dispatch
 from . import allatonce
 
 SENTINEL = segments.SENTINEL
@@ -89,6 +90,13 @@ def _iter_chunk_pairs(line_val_h, line_cap_h, dep_ok, ref_ok, budget,
     two-round half-approximate 1/1 evaluation.  Rows flagged for neither side
     are dropped before the quadratic emission; the stat accounting (pair slots
     materialized per line) accumulates into stats[stat_key].
+
+    Pipelined: chunk k+1's jitted pair program is dispatched BEFORE chunk k's
+    outputs are pulled (one batched device_get per chunk, staged async), so
+    the host-side merge of chunk k overlaps chunk k+1's device compute — the
+    same dispatch discipline as the sharded pass executor, at the cost of one
+    extra chunk's buffers in flight.  RDFIND_SYNC_PASSES=1 restores the
+    serial pull-then-dispatch schedule (bit-identical output).
     """
     row_keep = dep_ok[line_cap_h] | ref_ok[line_cap_h]
     lv, lc = line_val_h[row_keep], line_cap_h[row_keep]
@@ -118,6 +126,15 @@ def _iter_chunk_pairs(line_val_h, line_cap_h, dep_ok, ref_ok, budget,
 
     bounds = allatonce._chunk_boundaries(pairs_per_line, budget)
     pad = allatonce._pad_np
+    pipelined = not dispatch.sync_passes_forced()
+
+    def pull(chunk):
+        d, r, c, n_out = jax.device_get(chunk)  # ONE batched round trip
+        m = int(n_out)
+        return (d[:m].astype(np.int64), r[:m].astype(np.int64),
+                c[:m].astype(np.int64))
+
+    pend = None
     for bi in range(len(bounds) - 1):
         lo_line, hi_line = bounds[bi], bounds[bi + 1]
         if lo_line == hi_line:
@@ -129,7 +146,7 @@ def _iter_chunk_pairs(line_val_h, line_cap_h, dep_ok, ref_ok, budget,
             continue
         row_cap = segments.pow2_capacity(re - rs)
         pair_cap = segments.pow2_capacity(chunk_pairs)
-        d, r, c, n_out = _stage_pair_counts_masked(
+        chunk = _stage_pair_counts_masked(
             jnp.asarray(pad(lc[rs:re], row_cap, SENTINEL)),
             jnp.asarray(pad(dep_f_h[rs:re], row_cap, False)),
             jnp.asarray(pad(ref_f_h[rs:re], row_cap, False)),
@@ -138,10 +155,15 @@ def _iter_chunk_pairs(line_val_h, line_cap_h, dep_ok, ref_ok, budget,
             jnp.asarray(pad(
                 (np.arange(rs, re, dtype=np.int32) - pos_h[rs:re]) - rs, row_cap, 0)),
             capacity=pair_cap, balanced=balanced)
-        n_out = int(n_out)
-        yield (np.asarray(d)[:n_out].astype(np.int64),
-               np.asarray(r)[:n_out].astype(np.int64),
-               np.asarray(c)[:n_out].astype(np.int64))
+        dispatch.stage_to_host(chunk)
+        if not pipelined:
+            yield pull(chunk)
+            continue
+        if pend is not None:
+            yield pull(pend)
+        pend = chunk
+    if pend is not None:
+        yield pull(pend)
 
 
 def _merge_pair_parts(parts):

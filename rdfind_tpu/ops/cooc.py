@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import conditions as cc
+from ..runtime import dispatch
 from . import segments
 
 # Dep-tile rows per cooc block: (DT x C_pad) f32 tile = 16 MB per 1k captures.
@@ -263,25 +264,42 @@ def extract_packed(packed, rows: int, cols: int):
 
 
 def extract_packed_iter(thunks, tile_bits: int):
-    """Decode a stream of same-shaped packed tiles with batched host syncs.
+    """Decode a stream of packed tiles with batched, pipelined host syncs.
 
     thunks: callables dispatching one tile each, returning (packed, rows,
-    cols); tile_bits: packed bits per tile, which bounds how many tiles sit
-    on device awaiting decode (EXTRACT_DEVICE_ELEMS per batch).  Each batch
-    costs one counts sync; index pulls flush under PULL_BYTES_BUDGET.
-    Oversized tiles fall through to extract_packed's strip decode.  Returns
-    [(d, r)] host int64 arrays in thunk order — the shared decode behind
-    the dense strategy-0 sweep and strategy 2's candidate generation.
+    cols).  Tiles MAY differ in shape (small_to_large batches its mixed
+    lattice relations through one call); `tile_bits` must be an UPPER BOUND
+    on any tile's packed bits — it is what bounds how many tiles sit on
+    device awaiting decode (EXTRACT_DEVICE_ELEMS per batch), so an
+    underestimate breaks the residency math (ADVICE r5).  Each batch costs
+    one counts sync; index pulls flush under PULL_BYTES_BUDGET.
+
+    Pipelined schedule (unless RDFIND_SYNC_PASSES forces the serial one):
+    batch i+1's tiles are dispatched BEFORE batch i's counts are pulled, so
+    tile compute overlaps the count readback and index pulls; the batch size
+    is halved to keep the two-batches-in-flight residency inside the same
+    EXTRACT_DEVICE_ELEMS budget.  Oversized tiles fall through to
+    extract_packed's strip decode.  Returns [(d, r)] host int64 arrays in
+    thunk order — the shared decode behind the dense strategy-0 sweep and
+    strategy 2's candidate generation.
     """
     if tile_bits > EXTRACT_DEVICE_ELEMS:
         return [extract_packed(*t()) for t in thunks]
     out = [None] * len(thunks)
-    batch = max(1, EXTRACT_DEVICE_ELEMS // tile_bits)
+    pipelined = not dispatch.sync_passes_forced() and len(thunks) > 1
+    batch = max(1, EXTRACT_DEVICE_ELEMS // tile_bits // (2 if pipelined
+                                                         else 1))
     empty = (np.zeros(0, np.int64), np.zeros(0, np.int64))
-    for i in range(0, len(thunks), batch):
-        group = [(i + j, *t()) for j, t in enumerate(thunks[i:i + batch])]
-        counts = jax.device_get([packed_count(p, jnp.int32(r), jnp.int32(c))
-                                 for _, p, r, c in group])
+
+    def launch(lo):
+        group = [(lo + j, *t()) for j, t in enumerate(thunks[lo:lo + batch])]
+        counts = [packed_count(p, jnp.int32(r), jnp.int32(c))
+                  for _, p, r, c in group]
+        dispatch.stage_to_host(counts)
+        return group, counts
+
+    def drain_batch(group, counts):
+        counts = jax.device_get(counts)
         pend, pend_bytes = [], 0
 
         def drain():
@@ -298,10 +316,23 @@ def extract_packed_iter(thunks, tile_bits: int):
             cap = segments.pow2_capacity(n)
             pend.append((k, n, packed_nonzero(p, jnp.int32(rows),
                                               jnp.int32(cols), cap=cap)))
+            dispatch.stage_to_host(pend[-1][2])
             pend_bytes += 8 * cap
             if pend_bytes >= PULL_BYTES_BUDGET:
                 drain()
         drain()
+
+    prev = None
+    for lo in range(0, len(thunks), batch):
+        cur = launch(lo)
+        if not pipelined:
+            drain_batch(*cur)
+            continue
+        if prev is not None:
+            drain_batch(*prev)
+        prev = cur
+    if prev is not None:
+        drain_batch(*prev)
     return out
 
 

@@ -180,7 +180,7 @@ def _stage_keep_sharded(mesh, capacity: int):
     from jax.sharding import PartitionSpec as P
 
     from ..parallel import exchange
-    from ..parallel.mesh import AXIS
+    from ..parallel.mesh import AXIS, shard_map
 
     def f(dc, d1, d2, rc, r1, r2, valid):
         n = dc.shape[0]
@@ -202,7 +202,7 @@ def _stage_keep_sharded(mesh, capacity: int):
         keep = _keep_from_found(back, fam, valid, n)
         return keep, ovf_i + ovf_q
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(P(AXIS),) * 7,
         out_specs=(P(AXIS), P())))

@@ -30,6 +30,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -41,17 +42,48 @@ TILE_R = 128
 WK_MAX = 128
 
 
-def _unpack_tile(x):
-    """(TILE, WK) packed uint32 -> (TILE, WK*32) 0/1 bf16 planes, bit-major.
+@functools.lru_cache(maxsize=1)
+def _repeat_is_tile() -> bool:
+    """Whether this jax's pltpu.repeat follows np.tile lane order (newer
+    versions: lane j holds word j % WK) or np.repeat order (older: lane j
+    holds word j // n).  The unpack's shift formula must match, or the
+    planes stop being a permutation of the bits and the containment counts
+    go silently wrong.  Probed once through the interpreter, which agrees
+    with the Mosaic lowering within a jax version."""
+    try:
+        def k(x_ref, o_ref):
+            o_ref[:] = pltpu.repeat(x_ref[:], 2, axis=1)
 
-    Lane j of the result is bit (j // WK) of word (j % WK).  Only full-tile
-    ops: repeat, iota, shift, compare — no lane slicing (Mosaic requires
-    lane-dim slice offsets to be 128-aligned, which word steps are not).
+        # The first call can land inside an outer jit/pallas trace (the
+        # kernel is traced lazily); escape it so the probe runs eagerly —
+        # staged, its output would be a tracer and the comparison would
+        # bogusly take the except path.
+        with jax.ensure_compile_time_eval():
+            out = pl.pallas_call(
+                k, out_shape=jax.ShapeDtypeStruct((1, 4), jnp.int32),
+                interpret=True)(jnp.arange(2, dtype=jnp.int32).reshape(1, 2))
+            host = [int(v) for v in np.asarray(out)[0]]
+        return host == [0, 1, 0, 1]
+    except Exception:
+        return True  # current upstream semantics
+
+
+def _unpack_tile(x):
+    """(TILE, WK) packed uint32 -> (TILE, WK*32) 0/1 bf16 planes.
+
+    Lane j of the result is bit (j // WK) of word (j % WK) under tile-order
+    repeat, or bit (j % 32) of word (j // 32) under repeat-order — either is
+    a fixed permutation of the bits, harmless because both operands unpack
+    identically and the dot product is permutation-invariant.  Only
+    full-tile ops: repeat, iota, shift, compare — no lane slicing (Mosaic
+    requires lane-dim slice offsets to be 128-aligned, which word steps are
+    not).
     """
     wk = x.shape[1]
     rep = pltpu.repeat(x, 32, axis=1)
     lane = jax.lax.broadcasted_iota(jnp.uint32, rep.shape, 1)
-    shifts = jax.lax.div(lane, jnp.uint32(wk))
+    shifts = (jax.lax.div(lane, jnp.uint32(wk)) if _repeat_is_tile()
+              else jax.lax.rem(lane, jnp.uint32(32)))
     return ((rep >> shifts) & jnp.uint32(1)).astype(jnp.int32).astype(jnp.bfloat16)
 
 
@@ -114,7 +146,10 @@ def packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
         out_specs=pl.BlockSpec((TILE_D, TILE_R), lambda i, j, k: (i, j),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((TILE_D, TILE_R), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        # Renamed upstream (TPUCompilerParams -> CompilerParams); support both
+        # spellings so the kernel loads on old and new jax alike.
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(sketch_packed, ref_packed, ref_popc.reshape(1, r))

@@ -26,10 +26,32 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops import hashing, segments
 
 SENTINEL = segments.SENTINEL
+
+
+def pack_counters(values):
+    """Fuse scalar counters into ONE int32 lane array (device side).
+
+    The sharded pass programs used to return overflow flags and tail counters
+    as separate outputs, each costing its own blocking host_gather round trip
+    per pass.  Packing every psum'd scalar into a single (K,) lane means the
+    host reads ALL of a pass's control state in one (async-stageable) pull —
+    the per-pass sync-count contract of the pipelined executor.
+    """
+    return jnp.stack([jnp.asarray(v, jnp.int32) for v in values])
+
+
+def unpack_counters(host_arr, n: int, num_dev: int) -> np.ndarray:
+    """Host inverse of pack_counters over a P(AXIS)-gathered output.
+
+    Every lane is globally reduced (psum/pmax) on device, so all devices
+    carry identical copies; device 0's row is the answer.
+    """
+    return np.asarray(host_arr).reshape(num_dev, n)[0]
 
 
 @dataclasses.dataclass

@@ -24,6 +24,25 @@ from jax.sharding import PartitionSpec as P
 AXIS = "d"
 
 
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
+    """Version-portable shard_map: the ONE entry point for every sharded
+    program in this repo.
+
+    Newer jax exposes `jax.shard_map` with the replication check named
+    `check_vma`; 0.4.x only has `jax.experimental.shard_map.shard_map` with
+    the same flag named `check_rep`.  The pipelines disable the check either
+    way (their collective programs trip its conservative replication
+    inference), so the flag just needs to reach whichever spelling exists.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)
+
+
 _MULTIHOST_INITIALIZED = False
 
 
@@ -45,12 +64,36 @@ def initialize_multihost(coordinator: str, num_processes: int,
     global _MULTIHOST_INITIALIZED
     # NB: probing via jax.process_count() would itself initialize the XLA
     # backend and make initialize() illegal — use the distributed-state API.
-    if _MULTIHOST_INITIALIZED or jax.distributed.is_initialized():
+    # (is_initialized() is newer jax; 0.4.x readers go through global_state.)
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is None:
+        from jax._src import distributed as _dist
+
+        def is_init():
+            return _dist.global_state.client is not None
+    if _MULTIHOST_INITIALIZED or is_init():
         return  # already joined (jax.distributed.initialize is once-only)
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_processes,
-                               process_id=process_id,
-                               shutdown_timeout_seconds=shutdown_timeout_seconds)
+    try:
+        # Multi-process CPU backends need an explicit cross-process
+        # collectives implementation on some jax versions ("Multiprocess
+        # computations aren't implemented on the CPU backend" otherwise);
+        # gloo is the TCP one.  No effect on TPU clients.  NB the flag is
+        # not always readable as a config attribute — update() is the only
+        # portable accessor, so only an explicit non-default survives.
+        cur = getattr(jax.config, "jax_cpu_collectives_implementation", None)
+        if cur in (None, "none"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # versions without the flag don't need it
+    kwargs = dict(coordinator_address=coordinator,
+                  num_processes=num_processes, process_id=process_id)
+    try:
+        jax.distributed.initialize(
+            **kwargs, shutdown_timeout_seconds=shutdown_timeout_seconds)
+    except TypeError:
+        # Older jax predates the knob (its exit barrier is not configurable);
+        # joining with the default barrier beats not joining at all.
+        jax.distributed.initialize(**kwargs)
     _MULTIHOST_INITIALIZED = True
 
 
@@ -82,6 +125,20 @@ def host_gather(x) -> np.ndarray:
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def host_gather_many(xs) -> list:
+    """Batched host_gather: ONE blocking round trip for a list of arrays.
+
+    Single-process, a single device_get drains every pending transfer at once
+    (pair it with dispatch.stage_to_host so the copies were already in
+    flight).  Multi-process each array still needs its own allgather
+    collective, but issuing them back-to-back keeps the DCN pipe busy.
+    """
+    xs = list(xs)
+    if jax.process_count() == 1:
+        return jax.device_get(xs)
+    return [host_gather(x) for x in xs]
 
 
 def make_global(host_array: np.ndarray, mesh: Mesh) -> jax.Array:

@@ -1,0 +1,123 @@
+"""Pipelined dispatch: overlap device compute with host pulls.
+
+JAX dispatch is asynchronous on every backend — a jitted call returns as soon
+as the program is enqueued, and the host only blocks when it *reads* a device
+value.  The serial hot-path shape this repo grew up with (dispatch pass p,
+block on its counters, pull its blocks, only then dispatch pass p+1) therefore
+leaves the device idle during every host round trip.  The helpers here are the
+shared machinery of the pipelined executors (models/sharded._Pipeline
+._run_passes, ops/cooc.extract_packed_iter, models/small_to_large
+._iter_chunk_pairs):
+
+  * `stage_to_host` starts device->host copies the moment an output is
+    enqueued (`copy_to_host_async`), so the later blocking read mostly finds
+    the bytes already on host;
+  * `sync_passes_forced` reads RDFIND_SYNC_PASSES — the forced-synchronous
+    mode used by the differential tests (pipelined output must be
+    bit-identical to the serial schedule) and by benches measuring the
+    overlap win;
+  * `DispatchStats` counts blocking host syncs, the time spent in them, and
+    how much of that time was overlapped with already-enqueued successor
+    work — the telemetry that lets bench.py and --debug output PROVE the
+    overlap happened instead of asserting it.
+
+This module must stay import-light (os/time only, jax lazily at call sites'
+expense): ops/ and models/ import it, and runtime/driver imports models/.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def sync_passes_forced() -> bool:
+    """True when RDFIND_SYNC_PASSES forces the serial (pull-then-dispatch)
+    schedule.  Read at call time so tests and benches can flip modes without
+    rebuilding pipelines."""
+    return os.environ.get("RDFIND_SYNC_PASSES", "") not in ("", "0")
+
+
+def pass_depth(default: int = 2) -> int:
+    """How many passes the pipelined executor keeps in flight (>= 1 enqueued
+    successor while the head pass is read back).  RDFIND_PASS_INFLIGHT
+    overrides; forced-sync mode always runs depth 1."""
+    if sync_passes_forced():
+        return 1
+    return max(2, int(os.environ.get("RDFIND_PASS_INFLIGHT", default)))
+
+
+def stage_to_host(arrays) -> None:
+    """Start async device->host copies of already-enqueued outputs.
+
+    Best-effort: arrays without the method (host numpy riding a device-array
+    slot) or non-addressable multi-host shards are simply skipped — staging
+    is an overlap hint, the later blocking read is the correctness path.
+    """
+    for a in arrays:
+        copy = getattr(a, "copy_to_host_async", None)
+        if copy is None:
+            continue
+        try:
+            copy()
+        except Exception:
+            pass  # e.g. non-addressable global shards under multi-host
+
+
+class DispatchStats:
+    """Per-run dispatch telemetry accumulated by a pipelined executor.
+
+    n_host_syncs    -- blocking host reads issued (a batched device_get of
+                       many arrays counts ONCE: one round trip);
+    host_sync_ms    -- wall time spent blocked in those reads;
+    pull_overlap_ms -- the subset of host_sync_ms during which at least one
+                       successor pass was already enqueued on the device,
+                       i.e. readback time that ran concurrently with compute;
+    max_in_flight   -- peak number of enqueued-but-unread passes;
+    n_cap_retries   -- optimistic dispatches rolled back by a capacity
+                       overflow (grow caps, discard in-flight successors,
+                       re-run the failed pass).
+    """
+
+    __slots__ = ("n_host_syncs", "host_sync_ms", "pull_overlap_ms",
+                 "max_in_flight", "n_cap_retries")
+
+    def __init__(self):
+        self.n_host_syncs = 0
+        self.host_sync_ms = 0.0
+        self.pull_overlap_ms = 0.0
+        self.max_in_flight = 0
+        self.n_cap_retries = 0
+
+    def saw_in_flight(self, n: int) -> None:
+        self.max_in_flight = max(self.max_in_flight, n)
+
+    def pulled(self, seconds: float, overlapped: bool) -> None:
+        """Record one blocking host read of `seconds`, `overlapped` when a
+        successor pass was enqueued while it blocked."""
+        self.n_host_syncs += 1
+        self.host_sync_ms += seconds * 1e3
+        if overlapped:
+            self.pull_overlap_ms += seconds * 1e3
+
+    def timed_pull(self, fn, overlapped: bool):
+        """Run a blocking pull `fn()` under the sync clock; returns its value."""
+        t0 = time.perf_counter()
+        out = fn()
+        self.pulled(time.perf_counter() - t0, overlapped)
+        return out
+
+    def publish(self, stats: dict | None) -> None:
+        """Accumulate into a run-level stats dict (multiple pipelines per run:
+        the S2L lattice calls run_cooc once per level)."""
+        if stats is None:
+            return
+        stats["n_host_syncs"] = stats.get("n_host_syncs", 0) + self.n_host_syncs
+        stats["host_sync_ms"] = round(
+            stats.get("host_sync_ms", 0.0) + self.host_sync_ms, 3)
+        stats["pull_overlap_ms"] = round(
+            stats.get("pull_overlap_ms", 0.0) + self.pull_overlap_ms, 3)
+        stats["n_passes_in_flight"] = max(
+            stats.get("n_passes_in_flight", 0), self.max_in_flight)
+        stats["n_pair_cap_retries"] = (
+            stats.get("n_pair_cap_retries", 0) + self.n_cap_retries)

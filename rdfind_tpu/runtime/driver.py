@@ -802,6 +802,17 @@ def _emit_sinks(cfg: Config, phases: _Phases, counters: dict, table,
             f"{k[0]}/{k[1]}: {v}" for k, v in fams.items()), file=sys.stderr)
         counters.update({f"cinds-{k}": v for k, v in fams.items()})
 
+    if cfg.debug_level >= 1 and "n_host_syncs" in stats and _is_primary():
+        # Dispatch telemetry of the pipelined pass executor (sharded runs):
+        # proof the compute/readback overlap happened, not an assertion of it.
+        print(f"dispatch: passes={stats.get('n_pair_passes', 1)} "
+              f"in_flight={stats.get('n_passes_in_flight', 1)} "
+              f"host_syncs={stats['n_host_syncs']} "
+              f"sync_ms={stats.get('host_sync_ms', 0.0):.1f} "
+              f"overlap_ms={stats.get('pull_overlap_ms', 0.0):.1f} "
+              f"cap_retries={stats.get('n_pair_cap_retries', 0)} "
+              f"cap_p={stats.get('cap_p_final', 0)}", file=sys.stderr)
+
     if cfg.debug_level >= 2 and len(table):
         # DEBUG_LEVEL_SANITY: trivial CINDs in the output indicate a pipeline
         # bug (the reference's check, RDFind.scala:497-504).

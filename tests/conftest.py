@@ -5,23 +5,54 @@ This plays the role of the reference's embedded Flink minicluster
 """
 
 import os
+import subprocess
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-if "collective_call_terminate" not in os.environ["XLA_FLAGS"]:
+
+
+def _flags_supported(flags: str) -> bool:
+    """Whether THIS jaxlib accepts `flags` (unknown XLA flags abort the
+    process at backend init — parse_flags_from_env.cc CHECK-fails — so the
+    only safe probe is a killable subprocess).  Any probe failure (including
+    a hung remote-TPU tunnel from the image's sitecustomize, dodged via the
+    config.update below) just means "don't pin the flags"."""
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "jax.devices()")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags},
+            capture_output=True, timeout=120)
+    except Exception:
+        return False
+    return r.returncode == 0
+
+
+_COLLECTIVE_FLAGS = (
     # One-core box: the in-process CPU communicator CHECK-fails ("stuck")
     # when heavy per-device work staggers a rendezvous; raise its patience.
-    os.environ["XLA_FLAGS"] += (
-        " --xla_cpu_collective_timeout_seconds=7200"
-        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
-        " --xla_cpu_collective_call_terminate_timeout_seconds=7200")
-if "backend_optimization_level" not in os.environ["XLA_FLAGS"]:
+    # Older jaxlibs predate these flags and ABORT on unknown XLA_FLAGS, so
+    # they are probed before being pinned (a wrong guess kills every test).
+    " --xla_cpu_collective_timeout_seconds=7200"
+    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
+    " --xla_cpu_collective_call_terminate_timeout_seconds=7200")
+if ("collective_call_terminate" not in os.environ["XLA_FLAGS"]
+        and _flags_supported(os.environ["XLA_FLAGS"] + _COLLECTIVE_FLAGS)):
+    os.environ["XLA_FLAGS"] += _COLLECTIVE_FLAGS
+
+if ("backend_optimization_level" not in os.environ["XLA_FLAGS"]
+        and not os.environ.get("RDFIND_TEST_XLA_DEFAULT_OPT")):
     # The fast tier is XLA-CPU-compile-dominated; LLVM -O0 cuts cold compiles
     # ~40% with identical outputs (measured r5: discover_sharded cold 18.5 s
     # -> 11.2 s, same CINDs).  Tests only — production paths never see this.
+    # RDFIND_TEST_XLA_DEFAULT_OPT=1 lifts the pin so a tier can compile at
+    # the default (production) optimization level: the slow tier's
+    # test_default_xla_opt_smoke exercises that path in a subprocess, and CI
+    # can export the var to run the whole suite at default opt (ADVICE r5).
     # NB the persistent compilation cache was evaluated and REJECTED here:
     # on this image XLA's AOT loader warns of compile/host machine-feature
     # mismatches ("could lead to SIGILL") when reloading cached CPU

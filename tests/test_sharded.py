@@ -190,7 +190,7 @@ def test_global_row_counts_roundtrip(mesh8):
     from jax.sharding import PartitionSpec as P
 
     from rdfind_tpu.parallel import exchange
-    from rdfind_tpu.parallel.mesh import AXIS
+    from rdfind_tpu.parallel.mesh import AXIS, shard_map
 
     rng = np.random.default_rng(0)
     n = 256  # 32 rows/device
@@ -201,7 +201,7 @@ def test_global_row_counts_roundtrip(mesh8):
         c, ovf = exchange.global_row_counts([k], v, AXIS, 64, seed=3)
         return c, jnp.full(1, ovf, jnp.int32)
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         step, mesh=mesh8, in_specs=(P(AXIS), P(AXIS)),
         out_specs=(P(AXIS), P(AXIS)), check_vma=False))
     counts, ovf = fn(jnp.asarray(keys), jnp.asarray(valid))
@@ -344,7 +344,7 @@ def test_route_scattered_valid(mesh8):
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     from rdfind_tpu.parallel import exchange
-    from rdfind_tpu.parallel.mesh import AXIS
+    from rdfind_tpu.parallel.mesh import AXIS, shard_map
 
     n, cap = 64, 16
 
@@ -358,7 +358,7 @@ def test_route_scattered_valid(mesh8):
     rng = np.random.default_rng(3)
     col = rng.integers(0, 1000, size=8 * n).astype(np.int32)
     valid = rng.random(8 * n) < 0.3  # scattered, sparse
-    got, ovf = jax.shard_map(
+    got, ovf = shard_map(
         step, mesh=mesh8, in_specs=(P(AXIS), P(AXIS)), out_specs=P(AXIS),
         check_vma=False)(jnp.asarray(col), jnp.asarray(valid))
     assert int(np.asarray(ovf)[0]) == 0
