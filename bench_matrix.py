@@ -12,7 +12,10 @@ measurement machinery, AbstractFlinkProgram.java:65-77,175-182): one row per
             unary+binary, support >= 100.
 
 Usage: python bench_matrix.py [--configs 1,2] [--strategies 0,1,2,3]
-Prints one JSON line per row, then a summary table on stderr.
+                              [--dtypes int8,bf16]
+Prints one JSON line per row, then a summary table on stderr.  --dtypes adds
+one row per cooc membership dtype (int8 rides the doubled int8 MXU peak and
+is exact via int32 accumulation; pass "auto" for the probe-resolved default).
 
 CIND-count note: strategies 0/2 emit every CIND; small-to-large (1) and
 late-BB (3) emit their raw forms, whose 2/1 and 2/2 families omit
@@ -41,9 +44,10 @@ CONFIGS = {
 }
 
 
-def run_one(config_id: int, strategy: int) -> dict:
+def run_one(config_id: int, strategy: int, dtype: str = "auto") -> dict:
     from rdfind_tpu.models import (allatonce, approximate, late_bb,
                                    small_to_large)
+    from rdfind_tpu.ops import cooc
     from rdfind_tpu.utils.synth import generate_triples
 
     spec = CONFIGS[config_id]
@@ -54,18 +58,27 @@ def run_one(config_id: int, strategy: int) -> dict:
     discover = {0: allatonce.discover, 1: small_to_large.discover,
                 2: approximate.discover, 3: late_bb.discover}[strategy]
 
-    stats: dict = {}
-    discover(triples, spec["min_support"], stats=stats)  # warm-up (compile)
-    stats.clear()
-    t0 = time.perf_counter()
-    table = discover(triples, spec["min_support"], stats=stats)
-    wall = time.perf_counter() - t0
+    if dtype not in ("auto", "bf16", "int8"):
+        raise ValueError(f"dtype must be auto, bf16 or int8, got {dtype!r}")
+    saved = cooc.COOC_DTYPE
+    cooc.COOC_DTYPE = dtype
+    try:
+        stats: dict = {}
+        discover(triples, spec["min_support"], stats=stats)  # warm (compile)
+        stats.clear()
+        t0 = time.perf_counter()
+        table = discover(triples, spec["min_support"], stats=stats)
+        wall = time.perf_counter() - t0
+    finally:
+        cooc.COOC_DTYPE = saved
 
     total_pairs = int(stats.get("total_pairs", 0))
     return {
         "config": config_id,
         "label": spec["label"],
         "strategy": strategy,
+        "cooc_dtype": stats.get("cooc_dtype", dtype),
+        "dense_plan": stats.get("dense_plan"),
         "wall_s": round(wall, 3),
         "total_pairs": total_pairs,
         "pairs_per_sec_per_chip": round(total_pairs / wall, 1) if wall else 0,
@@ -80,6 +93,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="1,2")
     ap.add_argument("--strategies", default="0,1,2,3")
+    ap.add_argument("--dtypes", default="int8,bf16",
+                    help="cooc membership dtypes, one row each "
+                         "(int8 | bf16 | auto)")
     args = ap.parse_args()
 
     # The axon tunnel can wedge (block inside a C call); use bench.py's
@@ -91,23 +107,27 @@ def main():
     rows = []
     for cid in (int(c) for c in args.configs.split(",")):
         for strat in (int(s) for s in args.strategies.split(",")):
-            try:
-                row = run_one(cid, strat)
-            except Exception as e:  # keep reporting the rest of the matrix
-                row = {"config": cid, "strategy": strat,
-                       "error": f"{type(e).__name__}: {e}"}
-            row["backend"] = backend
-            rows.append(row)
-            print(json.dumps(row), flush=True)
+            for dtype in args.dtypes.split(","):
+                try:
+                    row = run_one(cid, strat, dtype=dtype.strip())
+                except Exception as e:  # keep reporting the rest of the matrix
+                    row = {"config": cid, "strategy": strat,
+                           "cooc_dtype": dtype.strip(),
+                           "error": f"{type(e).__name__}: {e}"}
+                row["backend"] = backend
+                rows.append(row)
+                print(json.dumps(row), flush=True)
 
-    print(f"{'cfg':>3} {'strat':>5} {'wall_s':>9} {'Mpairs/s':>9} "
-          f"{'cinds':>8}", file=sys.stderr)
+    print(f"{'cfg':>3} {'strat':>5} {'dtype':>5} {'wall_s':>9} "
+          f"{'Mpairs/s':>9} {'cinds':>8}", file=sys.stderr)
     for r in rows:
         if "error" in r:
-            print(f"{r['config']:>3} {r['strategy']:>5} ERROR {r['error']}",
+            print(f"{r['config']:>3} {r['strategy']:>5} "
+                  f"{r.get('cooc_dtype', '?'):>5} ERROR {r['error']}",
                   file=sys.stderr)
         else:
-            print(f"{r['config']:>3} {r['strategy']:>5} {r['wall_s']:>9.2f} "
+            print(f"{r['config']:>3} {r['strategy']:>5} "
+                  f"{r['cooc_dtype']:>5} {r['wall_s']:>9.2f} "
                   f"{r['pairs_per_sec_per_chip'] / 1e6:>9.2f} "
                   f"{r['cinds']:>8}", file=sys.stderr)
 

@@ -138,7 +138,8 @@ def _stage_membership(line_gid, cap_id, valid, min_support, *, l_pad, c_pad,
                       membership_dtype):
     """Membership matrix + the aggregates that fall out of it.
 
-    `membership_dtype` (callers pass cooc.COOC_DTYPE) is load-bearing: it
+    `membership_dtype` (callers pass the dense plan's resolved dtype) is
+    load-bearing: it
     both keys this jit's cache and selects the dtype build_membership
     actually uses (inlined here, the inputs' avals don't carry it).
 
@@ -364,13 +365,16 @@ def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
     plan = cooc.dense_plan(n_lines, num_caps)
     if plan is None:
         return None
-    l_pad, c_pad, tile = plan
+    l_pad, c_pad, tile = plan.l_pad, plan.c_pad, plan.tile
+    if stats is not None:
+        stats["dense_plan"] = plan.describe()
+        stats["cooc_dtype"] = plan.dtype
 
     if c_pad <= SINGLE_SHOT_C:
         packed, dep_count, lens, n_bits = _stage_dense_all(
             line_gid, cap_id, cand_valid, jnp.int32(min_support),
             cap_code, cap_v1, cap_v2, l_pad=l_pad, c_pad=c_pad,
-            membership_dtype=cooc.COOC_DTYPE)
+            membership_dtype=plan.dtype)
         # Two-dispatch pair extraction: pull the exact CIND count (8 bytes,
         # fused into the main dispatch), then pull only that many (dep, ref)
         # indices — never the bit matrix (cooc.extract_packed's rationale).
@@ -394,13 +398,13 @@ def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
     else:
         m, dep_count, lens = _stage_membership(
             line_gid, cap_id, cand_valid, jnp.int32(min_support),
-            membership_dtype=cooc.COOC_DTYPE,
+            membership_dtype=plan.dtype,
             l_pad=l_pad, c_pad=c_pad)
         lens_h = np.asarray(jax.lax.slice(lens, (0,), (n_lines,)), np.int64)
         dep_id, ref_id, support = cooc.discover_pairs_dense(
             m, dep_count, _fit_device(cap_code, c_pad),
             _fit_device(cap_v1, c_pad), _fit_device(cap_v2, c_pad),
-            min_support, num_caps, tile)
+            min_support, num_caps, tile, starts=plan.dep_tile_starts)
         (code_h, v1_h, v2_h, dep_count_h) = jax.device_get(
             (cap_code[:num_caps], cap_v1[:num_caps], cap_v2[:num_caps],
              jax.lax.slice(dep_count, (0,), (num_caps,))))

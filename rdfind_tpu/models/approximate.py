@@ -53,10 +53,11 @@ def _build_sketches(line_val_h, line_cap_h, num_caps, *, bits, num_hashes,
     line-aligned chunk; dependent sketches are AND-accumulated across chunks
     on device (sketch.intersect_dep_sketches_acc) — nothing crosses the
     tunnel during the build (r4 pulled every partial sketch matrix to host
-    and ANDed in numpy; VERDICT's first strategy-2 bottleneck).  cap_pad is
-    the pow2 capacity of num_caps so compiled programs are shared across
-    datasets; padded captures keep the all-ones empty-AND sketch and are
-    masked out by _candidate_pairs' dep/ref masks.
+    and ANDed in numpy; VERDICT's first strategy-2 bottleneck).  cap_pad
+    follows the cooc padding policy (tile-multiple by default — the
+    containment matmul then issues almost no padding rows — pow2-bucketed
+    under RDFIND_TILE_SCHEDULE=0); padded captures keep the all-ones
+    empty-AND sketch and are masked out by _candidate_pairs' dep/ref masks.
     """
     n = line_val_h.shape[0]
     starts = np.empty(n, bool)
@@ -66,7 +67,7 @@ def _build_sketches(line_val_h, line_cap_h, num_caps, *, bits, num_hashes,
     line_start_rows = np.flatnonzero(starts)
     num_lines = len(line_start_rows)
 
-    cap_pad = segments.pow2_capacity(num_caps)
+    cap_pad = cooc_ops.cap_pad(num_caps)
     sketches = jnp.full((cap_pad, bits // 32), 0xFFFFFFFF, jnp.uint32)
     # Chunk over whole lines so each line's Bloom is complete within its chunk.
     chunk_first_line = 0
@@ -130,7 +131,9 @@ def _candidate_pairs(sketches, num_caps, *, bits, num_hashes,
     Optional dep_mask/ref_mask restrict either side (the LateBB rounds).
     """
     cap_pad = sketches.shape[0]
-    tile = min(dep_tile, cap_pad)
+    # Tile width must divide cap_pad: a clamped dynamic_slice start would
+    # silently recompute earlier dep rows and mislabel their indices.
+    tile = cooc_ops.tile_for(cap_pad, dep_tile)
     ref_ids = jnp.arange(cap_pad, dtype=jnp.int32)
     ref_ok_h = np.zeros(cap_pad, bool)
     ref_ok_h[:num_caps] = True if ref_mask is None else ref_mask[:num_caps]
@@ -197,21 +200,24 @@ def _dense_verify_counts(line_val_h, line_cap_h, num_caps, cand_dep, cand_ref,
     line_gid = np.cumsum(starts, dtype=np.int64) - 1
     num_lines = int(line_gid[-1]) + 1
     plan = cooc_ops.dense_plan(num_lines, num_caps)
-    if plan is None or plan[1] > allatonce.SINGLE_SHOT_C:
+    if plan is None or plan.c_pad > allatonce.SINGLE_SHOT_C:
         return None
-    l_pad, c_pad, tile = plan
+    l_pad, c_pad, tile = plan.l_pad, plan.c_pad, plan.tile
     if stats is not None:
         lens = np.diff(np.append(np.flatnonzero(starts), n)).astype(np.int64)
         tot = int((lens * (lens - 1)).sum())
         stats[stat_key] = stats.get(stat_key, 0) + tot
         stats["total_pairs"] = stats.get("total_pairs", 0) + tot
+        stats["dense_plan"] = plan.describe()
+        stats["cooc_dtype"] = plan.dtype
 
     row_cap = segments.pow2_capacity(n)
     pad = allatonce._pad_np
     m = cooc_ops.build_membership(
         jnp.asarray(pad(line_gid.astype(np.int32), row_cap, l_pad)),
         jnp.asarray(pad(lc.astype(np.int32), row_cap, c_pad)),
-        jnp.arange(row_cap, dtype=jnp.int32) < n, l_pad=l_pad, c_pad=c_pad)
+        jnp.arange(row_cap, dtype=jnp.int32) < n, l_pad=l_pad, c_pad=c_pad,
+        dtype=plan.dtype)
 
     # Candidates grouped by dep tile (defensive sort: _candidate_pairs emits
     # dep-ascending, but the contract here is order-insensitive).  All tile
@@ -228,7 +234,7 @@ def _dense_verify_counts(line_val_h, line_cap_h, num_caps, cand_dep, cand_ref,
             cnt_sorted[a:b] = got[:b - a]
         spans, pulls, pend_bytes = [], [], 0
 
-    for lo in range(0, num_caps, tile):
+    for lo in plan.dep_tile_starts:
         a = np.searchsorted(d_sorted, lo)
         b = np.searchsorted(d_sorted, lo + tile)
         if a == b:

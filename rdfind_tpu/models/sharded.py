@@ -811,6 +811,11 @@ class _Pipeline:
                 freq=self.cap_f, exchange_a=self.cap_a, exchange_b=self.cap_b,
                 pairs=self.cap_p, exchange_c=self.cap_c, giant_rows=self.cap_g,
                 giant_pairs=self.cap_gp)
+            # The sketch/containment stages (sharded strategies 2/3) contract
+            # in the resolved cooc dtype; record it for bench/debug parity
+            # with the single-chip strategies.
+            from ..ops import cooc as cooc_ops
+            stats["cooc_dtype"] = cooc_ops.resolved_cooc_dtype()
 
     def _maybe_rebalance(self):
         """Greedy least-loaded reassignment of hot lines (the reference's
@@ -1288,10 +1293,14 @@ def _sharded_sketch_candidates(pipe, cap_table, bits, num_hashes, stats):
     cap_code, cap_v1, cap_v2, _ = cap_table
     num_caps = cap_code.shape[0]
     num_dev = pipe.num_dev
-    # Pad to a multiple of the device count so the per-device dep blocks tile
-    # the table exactly (pow2 bucket first for compile reuse).
-    c_pad = segments.pow2_capacity(num_caps)
-    c_pad = num_dev * (-(-c_pad // num_dev))
+    # Pad to a multiple of 128 * device count: the per-device dep blocks tile
+    # the table exactly AND stay 128-lane aligned for the containment matmul.
+    # cooc.cap_pad applies the active padding policy (tile-multiple by
+    # default — the mesh-tiled sketch matmul then issues almost no padding
+    # rows — pow2-bucketed under RDFIND_TILE_SCHEDULE=0 for compile reuse).
+    c_pad = cooc_ops.cap_pad(num_caps, mult=128 * num_dev)
+    if stats is not None:
+        stats["sketch_plan"] = {"c_real": int(num_caps), "c_pad": int(c_pad)}
     pad = lambda a: np.concatenate(
         [a.astype(np.int32), np.full(c_pad - num_caps, SENTINEL, np.int32)])
     packed = _sketch_step(

@@ -423,12 +423,12 @@ def _prepare_dense(padded, n, min_support, projections, use_fc_filter, use_ars,
     if n_lines == 0 or num_caps == 0:
         return ()
     plan = cooc_ops.dense_plan(n_lines, num_caps)
-    if plan is None or plan[1] > allatonce.SINGLE_SHOT_C:
+    if plan is None or plan.c_pad > allatonce.SINGLE_SHOT_C:
         return None
-    l_pad, c_pad, _ = plan
+    l_pad, c_pad = plan.l_pad, plan.c_pad
     m, dep_count_d, lens = allatonce._stage_membership(
         line_gid, cap_id, cand_valid, jnp.int32(min_support),
-        l_pad=l_pad, c_pad=c_pad, membership_dtype=cooc_ops.COOC_DTYPE)
+        l_pad=l_pad, c_pad=c_pad, membership_dtype=plan.dtype)
     cooc_m = _stage_cooc_full(m)
     (cap_code, cap_v1, cap_v2, dep_count, lens_h) = jax.device_get(
         (cap_code_d[:num_caps], cap_v1_d[:num_caps], cap_v2_d[:num_caps],
@@ -441,7 +441,8 @@ def _prepare_dense(padded, n, min_support, projections, use_fc_filter, use_ars,
                      n_line_rows=int(dep_count.astype(np.int64).sum()),
                      n_captures=num_caps, total_pairs=0,
                      max_line=int(lens64.max()) if lens64.size else 0,
-                     pair_backend="matmul")
+                     pair_backend="matmul",
+                     dense_plan=plan.describe(), cooc_dtype=plan.dtype)
     fn = _DenseCooc(m, cooc_m, dep_count_d, c_pad, n_lines, num_caps)
     return (fn, cap_code.astype(np.int64), cap_v1.astype(np.int64),
             cap_v2.astype(np.int64), dep_count.astype(np.int64), num_caps)

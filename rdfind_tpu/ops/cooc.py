@@ -6,10 +6,11 @@ as a CIND evidence and intersects evidence sets per dependent
 The count reformulation used across this repo tests `cooc(d, r) == support(d)`
 instead.  This module computes the *entire* cooc matrix as one blocked matmul:
 
-    M    : (lines x captures) 0/1 membership in HBM — bf16 by default,
-           int8 via RDFIND_COOC_DTYPE=int8
-    cooc : M^T M on the MXU — f32 accumulation for bf16 (exact while
-           lines < 2^24), int32 for int8 (exact to int32 counts)
+    M    : (lines x captures) 0/1 membership in HBM — int8 by default on
+           int8-MXU backends (one-time runtime probe), bf16 elsewhere or
+           via RDFIND_COOC_DTYPE=bf16
+    cooc : M^T M on the MXU — int32 accumulation for int8 (exact to int32
+           counts), f32 for bf16 (exact while lines < 2^24)
 
 which replaces the sort-dominated chunked pair pipeline (r2 bench: lexsort over
 every 4M-pair chunk + a host sync per chunk left the MXU idle and lost 13x to
@@ -25,6 +26,7 @@ np.unpackbits + nonzero to read off CIND pairs.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
 
@@ -46,14 +48,161 @@ DENSE_M_BUDGET_BYTES = int(os.environ.get("RDFIND_DENSE_M_BUDGET", 6 << 30))
 # dense plan must fall back (int8 mode accumulates in int32 — no such cap).
 MAX_LINES_EXACT_F32 = 1 << 24
 
-# Membership element type for the cooc matmuls.  bf16 rides the MXU's native
-# path; int8 ("RDFIND_COOC_DTYPE=int8") halves membership HBM, doubles the
-# v5e's MXU peak (int8 ~2x bf16 FLOP/s), and its int32 accumulation is exact
-# far past f32's 2^24-line cap — kept opt-in until measured faster on-chip.
-COOC_DTYPE = os.environ.get("RDFIND_COOC_DTYPE", "bf16")
-if COOC_DTYPE not in ("bf16", "int8"):
-    raise ValueError(f"RDFIND_COOC_DTYPE must be bf16 or int8, "
+# Membership element type for the cooc matmuls.  "auto" (the default) probes
+# the backend once and picks int8 wherever the hardware int8 matmul path
+# pays off (the TPU MXU: int8 halves membership HBM, doubles the v5e peak —
+# 394 int8 TOPS vs 197 bf16 TFLOPS — and its int32 accumulation is exact far
+# past f32's 2^24-line cap), falling back to bf16 elsewhere (XLA CPU's
+# generic int8 loops are slower than bf16).  RDFIND_COOC_DTYPE pins either
+# mode explicitly; outputs are bit-identical.
+COOC_DTYPE = os.environ.get("RDFIND_COOC_DTYPE", "auto")
+if COOC_DTYPE not in ("auto", "bf16", "int8"):
+    raise ValueError(f"RDFIND_COOC_DTYPE must be auto, bf16 or int8, "
                      f"got {COOC_DTYPE!r}")
+
+# Tile-schedule padding policy: on (default), dense plans pad to tile
+# multiples (occupancy > 0.9 on real workloads) and skip all-padding dep
+# tiles; RDFIND_TILE_SCHEDULE=0 restores the legacy pow2-bucketed plan
+# (roughly 2x issued FLOPs in the worst case, but maximal compiled-program
+# reuse across datasets).  Both policies are bit-identical in output —
+# differential-tested across all four traversal strategies.
+TILE_SCHEDULE = os.environ.get("RDFIND_TILE_SCHEDULE", "1").lower() \
+    not in ("0", "false", "no")
+
+# Row padding granule of the membership matrix under the tile schedule: a
+# multiple of every dtype's sublane tile (f32 8, bf16 16, int8 32) with
+# enough slack that distinct tiny test datasets still bucket together.
+LINE_MULT = 256
+# Column granule: the MXU lane width and the 32-bit packing word both divide
+# 128, and every dep-tile width is a multiple of it.
+CAP_MULT = 128
+
+
+@functools.lru_cache(maxsize=1)
+def int8_matmul_supported() -> bool:
+    """One-time runtime probe: does this backend lower an int8 x int8 matmul
+    with int32 accumulation?  Checked eagerly on a tiny product so the auto
+    dtype can fall back to bf16 before any hot-path program is traced."""
+    try:
+        a = jnp.ones((8, 8), jnp.int8)
+        out = jax.lax.dot_general(a, a, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        return bool(jax.device_get(out)[0, 0] == 8)
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=1)
+def _int8_pays_off() -> bool:
+    """Whether "auto" resolves to int8: the matmul must lower AND the backend
+    must have a hardware int8 path worth taking.  The TPU MXU runs int8 at
+    2x its bf16 rate (v5e: 394 TOPS vs 197 TFLOPS); XLA *CPU* lowers int8
+    GEMM to generic loops measured ~4x SLOWER than bf16, so the CPU proxy
+    keeps bf16 and the wall clock does not regress."""
+    return jax.default_backend() == "tpu" and int8_matmul_supported()
+
+
+def resolved_cooc_dtype() -> str:
+    """The membership dtype actually in effect ("bf16" or "int8").
+
+    Reads COOC_DTYPE at call time (tests monkeypatch the module attribute);
+    only the backend probes behind "auto" are cached."""
+    if COOC_DTYPE != "auto":
+        return COOC_DTYPE
+    return "int8" if _int8_pays_off() else "bf16"
+
+
+def round_up(n: int, mult: int) -> int:
+    """Smallest multiple of `mult` >= max(n, 1)."""
+    return -(-max(int(n), 1) // mult) * mult
+
+
+def tile_for(c_pad: int, tile_max: int = DEFAULT_TILE) -> int:
+    """Largest dep-tile width that divides `c_pad`, is a power-of-two
+    multiple of CAP_MULT, and stays <= tile_max.
+
+    Divisibility keeps every host-loop tile start exact under dynamic_slice's
+    edge clamping (a clamped start would silently recompute earlier rows and
+    emit duplicate pairs); the pow2 structure keeps tile widths MXU-friendly.
+    """
+    assert c_pad % CAP_MULT == 0, c_pad
+    m = c_pad // CAP_MULT
+    t = CAP_MULT * (m & -m)  # largest pow2 divisor of m, in columns
+    return max(CAP_MULT, min(t, tile_max, c_pad))
+
+
+def cap_pad(num_caps: int, mult: int = CAP_MULT) -> int:
+    """Capture-axis padding under the active policy: tile-multiple (tight)
+    when TILE_SCHEDULE is on, pow2-bucketed otherwise.  `mult` raises the
+    granule (the sharded sketch path needs device-count divisibility)."""
+    if TILE_SCHEDULE:
+        return round_up(num_caps, mult)
+    return round_up(max(CAP_MULT, segments.pow2_capacity(num_caps)), mult)
+
+
+@dataclasses.dataclass(frozen=True)
+class DensePlan:
+    """Shape plan + tile schedule for the dense cooc path.
+
+    The schedule (dep_tile_starts) enumerates the dep tiles that contain at
+    least one real capture; all-padding tiles are never dispatched, and the
+    occupancy accounting (real_flops / issued_flops) is what benches report
+    as occupancy-corrected MFU instead of padded-FLOP MFU.
+    """
+
+    l_pad: int
+    c_pad: int
+    tile: int
+    n_lines: int
+    num_caps: int
+    dtype: str
+
+    def __iter__(self):  # legacy (l_pad, c_pad, tile) unpacking
+        return iter((self.l_pad, self.c_pad, self.tile))
+
+    @property
+    def dep_tile_starts(self) -> tuple:
+        """Dep-tile starts whose tile intersects [0, num_caps)."""
+        return tuple(lo for lo in range(0, self.c_pad, self.tile)
+                     if lo < self.num_caps)
+
+    @property
+    def n_tiles(self) -> int:
+        return self.c_pad // self.tile
+
+    @property
+    def n_tiles_skipped(self) -> int:
+        return self.n_tiles - len(self.dep_tile_starts)
+
+    @property
+    def issued_flops(self) -> int:
+        """MACs*2 actually dispatched by the scheduled tile sweep."""
+        return 2 * self.l_pad * self.c_pad * self.tile \
+            * len(self.dep_tile_starts)
+
+    @property
+    def real_flops(self) -> int:
+        """MACs*2 the unpadded workload needs."""
+        return 2 * self.n_lines * self.num_caps * self.num_caps
+
+    @property
+    def occupancy(self) -> float:
+        return self.real_flops / max(self.issued_flops, 1)
+
+    def describe(self) -> dict:
+        """Occupancy record for run stats / --debug / bench JSON."""
+        return {
+            "policy": "tile" if TILE_SCHEDULE else "pow2",
+            "dtype": self.dtype,
+            "l_real": self.n_lines, "l_pad": self.l_pad,
+            "c_real": self.num_caps, "c_pad": self.c_pad,
+            "tile": self.tile,
+            "n_tiles": self.n_tiles,
+            "n_tiles_skipped": self.n_tiles_skipped,
+            "issued_flops": self.issued_flops,
+            "real_flops": self.real_flops,
+            "occupancy": round(self.occupancy, 4),
+        }
 
 
 def cooc_dot(a, b, dims=((0,), (0,))):
@@ -80,26 +229,36 @@ def pack_bool(x):
 
 
 def dense_plan(n_lines: int, num_caps: int, tile: int = DEFAULT_TILE):
-    """Shape plan for the dense path, or None when it does not fit.
+    """DensePlan for the dense path, or None when it does not fit.
 
-    Returns (l_pad, c_pad, tile) with c_pad a multiple of 128 (MXU lanes and
-    32-bit packing) and l_pad a multiple of 8 (f32 sublanes).
+    c_pad is always a multiple of CAP_MULT=128 (MXU lanes and 32-bit packing)
+    and of the tile (exact dep-tile starts under dynamic_slice clamping).
+    Under the default tile-multiple policy l_pad/c_pad hug the real shape
+    (occupancy > 0.9 on non-degenerate workloads); RDFIND_TILE_SCHEDULE=0
+    restores the legacy pow2 buckets, whose worst case issues ~2x the rows
+    and ~2x the columns (the headline workload measured ~56% row occupancy).
     """
     if n_lines == 0 or num_caps == 0:
         return None
-    if COOC_DTYPE != "int8" and n_lines >= MAX_LINES_EXACT_F32:
+    dtype = resolved_cooc_dtype()
+    if dtype != "int8" and n_lines >= MAX_LINES_EXACT_F32:
         return None  # int8 accumulates in int32: exact to 2^31 counts
-    # Power-of-two buckets so compiled programs are reused across datasets
-    # (the repo-wide capacity policy, segments.pow2_capacity).  c_pad a pow2
-    # >= 128 is automatically a multiple of the (pow2) tile, which keeps every
-    # host-loop tile start exact under dynamic_slice's edge clamping.
-    l_pad = max(8, segments.pow2_capacity(n_lines))
-    c_pad = max(128, segments.pow2_capacity(num_caps))
-    tile = min(tile, c_pad)
-    elem_bytes = 1 if COOC_DTYPE == "int8" else 2
+    if TILE_SCHEDULE:
+        l_pad = round_up(n_lines, LINE_MULT)
+        c_pad = cap_pad(num_caps)
+        tile = tile_for(c_pad, tile)
+    else:
+        # Legacy pow2 buckets: maximal compiled-program reuse across datasets
+        # (segments.pow2_capacity); c_pad a pow2 >= 128 is automatically a
+        # multiple of the (pow2) tile.
+        l_pad = max(8, segments.pow2_capacity(n_lines))
+        c_pad = cap_pad(num_caps)
+        tile = min(tile, c_pad)
+    elem_bytes = 1 if dtype == "int8" else 2
     if l_pad * c_pad * elem_bytes > DENSE_M_BUDGET_BYTES:
         return None
-    return l_pad, c_pad, tile
+    return DensePlan(l_pad=l_pad, c_pad=c_pad, tile=tile, n_lines=n_lines,
+                     num_caps=num_caps, dtype=dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("l_pad", "c_pad", "dtype"))
@@ -116,14 +275,15 @@ def build_membership(line_gid, line_cap, valid, *, l_pad: int, c_pad: int,
                      dtype: str | None = None):
     """Scatter (line, capture) rows into the (l_pad, c_pad) 0/1 matrix.
 
-    The element type (bf16 default, int8 via COOC_DTYPE; `dtype` overrides)
+    The element type (resolved_cooc_dtype() by default; `dtype` overrides)
     is a STATIC jit key: the inputs' avals don't carry it, so it must key the
     cache explicitly or a dtype flip would silently reuse the other mode's
     compiled program.  Downstream consumers take `m` itself, whose aval
     re-keys them."""
     return _build_membership(line_gid, line_cap, valid, l_pad=l_pad,
                              c_pad=c_pad,
-                             dtype=COOC_DTYPE if dtype is None else dtype)
+                             dtype=resolved_cooc_dtype() if dtype is None
+                             else dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("tile",))
@@ -240,13 +400,13 @@ def extract_packed(packed, rows: int, cols: int):
     if total_bits <= EXTRACT_DEVICE_ELEMS:
         return extract_packed_iter([lambda: (packed, rows, cols)],
                                    total_bits)[0]
-    # Strip heights stay pow2 (words is pow2 by the c_pad policy), so every
-    # strip of a pow2-height tile is full height and program reuse holds.
-    # Strips are just same-shaped small tiles: decode through the shared
-    # batched iterator.  tile_bits is clamped for the pathological one-row-
-    # over-budget shape (words*32 > EXTRACT_DEVICE_ELEMS), where a single
-    # row must decode in one shot anyway and clamping avoids bouncing back
-    # into this strip path.
+    # Strips are just same-shaped small tiles decoded through the shared
+    # batched iterator; a partial final strip compiles its own (smaller)
+    # program, which the iterator's per-shape thunks already allow (the
+    # tile-multiple c_pad policy means words need not be pow2).  tile_bits
+    # is clamped for the pathological one-row-over-budget shape
+    # (words*32 > EXTRACT_DEVICE_ELEMS), where a single row must decode in
+    # one shot anyway and clamping avoids bouncing back into this strip path.
     h = max(1, EXTRACT_DEVICE_ELEMS // (words * 32))
     los = list(range(0, min(rows, packed.shape[0]), h))
 
@@ -344,14 +504,16 @@ def unpack_cind_bits(packed: np.ndarray, c_pad: int) -> np.ndarray:
 
 
 def discover_pairs_dense(m, dep_count, cap_code, cap_v1, cap_v2, min_support,
-                         num_caps: int, tile: int):
+                         num_caps: int, tile: int, starts=None):
     """Run the tiled cooc pass; return (dep_id, ref_id, support) numpy arrays.
 
-    m: (l_pad, c_pad) device membership matrix.  The host loops over dep
-    tiles dispatching the packed CIND blocks, then decodes them on device:
-    one batched pull of all tile popcounts, one batched pull of the sized
-    nonzeros — only the set-bit index pairs ever reach the host (same
-    two-phase decode as extract_packed, batched across tiles).
+    m: (l_pad, c_pad) device membership matrix.  The host loops over the
+    scheduled dep tiles (`starts`, default: every tile intersecting
+    [0, num_caps) — all-padding tiles are never dispatched) sending the
+    packed CIND blocks, then decodes them on device: one batched pull of all
+    tile popcounts, one batched pull of the sized nonzeros — only the
+    set-bit index pairs ever reach the host (same two-phase decode as
+    extract_packed, batched across tiles).
     """
     c_pad = m.shape[1]
     dep_count_d = jnp.asarray(dep_count, jnp.int32)
@@ -360,7 +522,8 @@ def discover_pairs_dense(m, dep_count, cap_code, cap_v1, cap_v2, min_support,
     v2_d = jnp.asarray(cap_v2, jnp.int32)
     ms = jnp.int32(min_support)
 
-    los = list(range(0, num_caps, tile))
+    los = list(starts) if starts is not None else list(range(0, num_caps,
+                                                             tile))
 
     def make(lo):
         return lambda: (cooc_cind_tile(m, jnp.int32(lo), dep_count_d, code_d,

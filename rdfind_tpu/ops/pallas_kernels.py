@@ -11,8 +11,30 @@ The jnp path (sketch.contains_matrix) unpacks both sides to full 0/1 planes in
 HBM — a 32x write + read amplification of pure memory traffic — before the MXU
 contraction.  The kernel below never materializes planes: each grid step DMAs a
 packed (TILE, WK) uint32 tile into VMEM, unpacks it in-register, and feeds the
-MXU with a (TILE, WK*32) contraction, accumulating across word chunks in an f32
-VMEM scratch.  HBM traffic drops to the packed bytes.
+MXU with a (TILE, WK*32) contraction, accumulating across word chunks in a VMEM
+scratch.  HBM traffic drops to the packed bytes.
+
+MXU-rate notes (the round-6 rework):
+
+  * planes unpack to **int8 by default** (int32 accumulation — exact, counts
+    <= bits): half the VMEM per unpacked operand and 2x the MXU rate of the
+    bf16 fallback on int8-capable chips (v5e: 394 int8 TOPS vs 197 bf16
+    TFLOPS).  `unpack_dtype="bf16"` keeps the old formulation for backends
+    whose MXU has no int8 path — both modes are bit-exact vs the jnp planes
+    path (counts are small integers either way).
+  * the **dep-tile unpack is hoisted out of the ref-tile grid dimension**:
+    the ref (j) dimension revisits the same dep tile nj times, so the shifted
+    planes are computed once at j == 0 into a persistent VMEM scratch and
+    re-read for every later j — the per-step VPU work drops to one ref-tile
+    unpack.  The hoist is skipped (per-step unpack, as before) only when the
+    full-width dep planes would not fit the scratch budget.
+  * WK (words per K step) widens with the int8 VMEM savings, so each K-grid
+    DMA moves a larger packed block and the MXU sees longer contractions.
+  * the K grid dimension is marked "arbitrary" (sequential revisiting) in
+    dimension_semantics, which is what lets Mosaic double-buffer the K-step
+    operand DMAs against the matmul of the previous chunk; the ref-tile (j)
+    dimension is also "arbitrary" because the hoisted scratch carries state
+    across it.
 
 Layout notes (see /opt/skills/guides/pallas_guide.md): Mosaic cannot slice the
 lane dimension at non-128-aligned offsets, so the unpack avoids slicing
@@ -20,8 +42,9 @@ entirely: `pltpu.repeat(x, 32, axis=1)` tiles the packed words 32x along lanes
 (np.tile semantics: lane j holds word j % WK), and the per-lane shift is
 j // WK.  That yields planes in *bit-major* lane order — a fixed permutation of
 the contraction dimension, harmless because both operands unpack identically
-and the dot product is permutation-invariant.  uint32->bf16 needs a two-step
-cast through int32 (Mosaic has no direct lowering, r2 bench failure).
+and the dot product is permutation-invariant.  Narrowing casts out of uint32
+go through int32 (Mosaic has no direct uint32->bf16 lowering, r2 bench
+failure; the int8 path keeps the same two-step shape for symmetry).
 """
 
 from __future__ import annotations
@@ -37,9 +60,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 TILE_D = 128
 TILE_R = 128
-# Words per K grid step: 128 words = 4096 contraction lanes = 1 MB of unpacked
-# bf16 per operand tile in VMEM, well under budget while keeping the MXU fed.
-WK_MAX = 128
+# Words per K grid step, by unpack dtype: the unpacked operand tile is
+# (TILE, WK*32) elements in VMEM, so int8's 1-byte planes afford twice the
+# chunk of bf16 at the same budget (256 words = 8192 contraction lanes = 1 MB
+# per int8 operand tile) — larger K-step DMAs, longer MXU contractions.
+WK_MAX = {"int8": 256, "bf16": 128}
+# VMEM budget for the hoisted full-width dep planes (TILE_D x bits x elem
+# bytes).  4 MB covers bits <= 32768 in int8 / 16384 in bf16 and leaves the
+# double-buffered operand tiles + accumulator well inside the ~16 MB core
+# budget; wider sketches fall back to the per-step unpack.
+HOIST_PLANE_BUDGET = 4 << 20
 
 
 @functools.lru_cache(maxsize=1)
@@ -68,8 +98,24 @@ def _repeat_is_tile() -> bool:
         return True  # current upstream semantics
 
 
-def _unpack_tile(x):
-    """(TILE, WK) packed uint32 -> (TILE, WK*32) 0/1 bf16 planes.
+def _default_unpack_dtype() -> str:
+    """The resolved cooc dtype: int8 wherever the backend's int8 matmul path
+    pays off (the cooc probes), bf16 elsewhere or when pinned via
+    RDFIND_COOC_DTYPE — one policy for every containment/cooc contraction."""
+    from . import cooc
+
+    return cooc.resolved_cooc_dtype()
+
+
+def _repeat32(x):
+    """The 32x lane repeat behind the unpack — module-level indirection so
+    tests can substitute a jnp.tile / jnp.repeat emulation of either lane
+    order and exercise both _repeat_is_tile branches on any jax version."""
+    return pltpu.repeat(x, 32, axis=1)
+
+
+def _unpack_tile(x, dtype: str, tile_order: bool):
+    """(TILE, WK) packed uint32 -> (TILE, WK*32) 0/1 planes in `dtype`.
 
     Lane j of the result is bit (j // WK) of word (j % WK) under tile-order
     repeat, or bit (j % 32) of word (j // 32) under repeat-order — either is
@@ -80,59 +126,107 @@ def _unpack_tile(x):
     not).
     """
     wk = x.shape[1]
-    rep = pltpu.repeat(x, 32, axis=1)
+    rep = _repeat32(x)
     lane = jax.lax.broadcasted_iota(jnp.uint32, rep.shape, 1)
-    shifts = (jax.lax.div(lane, jnp.uint32(wk)) if _repeat_is_tile()
+    shifts = (jax.lax.div(lane, jnp.uint32(wk)) if tile_order
               else jax.lax.rem(lane, jnp.uint32(32)))
-    return ((rep >> shifts) & jnp.uint32(1)).astype(jnp.int32).astype(jnp.bfloat16)
+    bits = ((rep >> shifts) & jnp.uint32(1)).astype(jnp.int32)
+    return bits.astype(jnp.int8 if dtype == "int8" else jnp.bfloat16)
 
 
-def _contains_kernel(s_ref, r_ref, popc_ref, out_ref, acc_ref):
+def _contains_kernel(s_ref, r_ref, popc_ref, out_ref, s_plane_ref, acc_ref, *,
+                     nk: int, wk: int, dtype: str, tile_order: bool,
+                     hoist: bool, acc_dt):
     """One (TILE_D, TILE_R) tile of the containment matrix.
 
     s_ref: (TILE_D, WK) packed dep sketches; r_ref: (TILE_R, WK) packed ref bit
     sets; popc_ref: (1, TILE_R) per-ref set bit counts.  out[d, r] = 1 iff every
     set bit of ref r is set in sketch d, tested as <unpacked s, unpacked r> ==
     popcount(r) — the same MXU formulation as the jnp path, minus the HBM
-    planes.  The K grid dim accumulates word chunks into acc_ref.
+    planes.  The K grid dim accumulates word chunks into acc_ref; with `hoist`,
+    s_plane_ref carries the full-width unpacked dep planes across the ref (j)
+    dimension, filled once per (i, k) while j == 0.
     """
     k = pl.program_id(2)
-    nk = pl.num_programs(2)
+    j = pl.program_id(1)
+    wk32 = wk * 32
 
     @pl.when(k == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    s_b = _unpack_tile(s_ref[:])
-    r_b = _unpack_tile(r_ref[:])
+    if hoist:
+        # nk == 1 keeps the chunk offset static; otherwise wk32 is a
+        # 128-multiple (wk == WK_MAX there), so the dynamic lane offset stays
+        # Mosaic-aligned.
+        chunk = (slice(0, wk32) if nk == 1
+                 else pl.ds(k * wk32, wk32))
+
+        @pl.when(j == 0)
+        def _fill():
+            s_plane_ref[:, chunk] = _unpack_tile(s_ref[:], dtype, tile_order)
+
+        s_b = s_plane_ref[:, chunk]
+    else:
+        s_b = _unpack_tile(s_ref[:], dtype, tile_order)
+    r_b = _unpack_tile(r_ref[:], dtype, tile_order)
     acc_ref[:] += jax.lax.dot_general(
         s_b, r_b, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=acc_dt)
 
     @pl.when(k == nk - 1)
     def _finalize():
         out_ref[:] = (acc_ref[:].astype(jnp.int32) == popc_ref[:]).astype(jnp.uint8)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
 def packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           unpack_dtype: str | None = None):
     """(D, R) uint8 containment matrix from packed uint32 rows.
 
     sketch_packed: (D, W) packed dep sketches; ref_packed: (R, W) packed ref bit
     sets; ref_popc: (R,) int32 popcounts of each ref row.  D and R must be
     multiples of the 128-lane tile; W a power-of-two number of words (bits a
     power of two >= 32, as ops/sketch.py enforces).  `interpret=True` runs the
-    kernel in the Pallas interpreter (CPU tests).
+    kernel in the Pallas interpreter (CPU tests).  `unpack_dtype` selects the
+    in-register plane type ("int8" wherever int8 matmul lowers — the default —
+    else "bf16"); both are exact and bit-identical.
     """
+    if unpack_dtype is None:
+        unpack_dtype = _default_unpack_dtype()
+    if unpack_dtype not in WK_MAX:
+        raise ValueError(f"unpack_dtype must be int8 or bf16, "
+                         f"got {unpack_dtype!r}")
+    # The pltpu.repeat lane-order probe keys the jit cache: a monkeypatched
+    # or version-dependent flip must retrace the kernel, not reuse the other
+    # order's program.
+    return _packed_contains_matrix(sketch_packed, ref_packed, ref_popc,
+                                   interpret=interpret,
+                                   unpack_dtype=unpack_dtype,
+                                   tile_order=_repeat_is_tile())
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "unpack_dtype",
+                                             "tile_order"))
+def _packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
+                            interpret: bool, unpack_dtype: str,
+                            tile_order: bool):
     d, w = sketch_packed.shape
     r = ref_packed.shape[0]
-    wk = min(w, WK_MAX)
+    wk = min(w, WK_MAX[unpack_dtype])
     if d % TILE_D or r % TILE_R or w % wk:
         raise ValueError(f"shapes must be tile-aligned, got D={d} R={r} W={w}")
-    grid = (d // TILE_D, r // TILE_R, w // wk)
+    nk = w // wk
+    grid = (d // TILE_D, r // TILE_R, nk)
+    elem = 1 if unpack_dtype == "int8" else 2
+    plane_dt = jnp.int8 if unpack_dtype == "int8" else jnp.bfloat16
+    acc_dt = jnp.int32 if unpack_dtype == "int8" else jnp.float32
+    hoist = TILE_D * w * 32 * elem <= HOIST_PLANE_BUDGET
+    kernel = functools.partial(_contains_kernel, nk=nk, wk=wk,
+                               dtype=unpack_dtype, tile_order=tile_order,
+                               hoist=hoist, acc_dt=acc_dt)
     return pl.pallas_call(
-        _contains_kernel,
+        kernel,
         out_shape=jax.ShapeDtypeStruct((d, r), jnp.uint8),
         grid=grid,
         in_specs=[
@@ -145,11 +239,19 @@ def packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
         ],
         out_specs=pl.BlockSpec((TILE_D, TILE_R), lambda i, j, k: (i, j),
                                memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.VMEM((TILE_D, TILE_R), jnp.float32)],
+        scratch_shapes=[
+            # Hoisted dep planes (full width when hoisting, one chunk's worth
+            # of scratch otherwise so the allocation stays tiny and unused).
+            pltpu.VMEM((TILE_D, (w if hoist else wk) * 32), plane_dt),
+            pltpu.VMEM((TILE_D, TILE_R), acc_dt),
+        ],
         # Renamed upstream (TPUCompilerParams -> CompilerParams); support both
-        # spellings so the kernel loads on old and new jax alike.
+        # spellings so the kernel loads on old and new jax alike.  j and k are
+        # "arbitrary": j carries the hoisted-scratch state sequentially, and
+        # k's sequential revisiting is what Mosaic double-buffers the K-step
+        # operand DMAs across.
         compiler_params=getattr(pltpu, "CompilerParams",
                                 getattr(pltpu, "TPUCompilerParams", None))(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(sketch_packed, ref_packed, ref_popc.reshape(1, r))

@@ -155,6 +155,15 @@ def pallas_eligible(bits: int, backend: str | None = None) -> bool:
     return backend == "pallas" and bits % 128 == 0
 
 
+def _contract_dtype() -> str:
+    """Element type of the containment contraction: the resolved cooc dtype
+    (int8 by default — int32 accumulation, exact; bf16 where int8 matmul
+    does not lower).  Lazy import: cooc owns the probe and the env knob."""
+    from . import cooc
+
+    return cooc.resolved_cooc_dtype()
+
+
 def contains_matrix(sketch_tile, ref_ids, ref_valid, *, bits: int,
                     num_hashes: int, backend: str | None = None,
                     interpret: bool = False, ref_pack=None):
@@ -162,9 +171,10 @@ def contains_matrix(sketch_tile, ref_ids, ref_valid, *, bits: int,
 
     sketch_tile: (D, W) packed dep sketches; ref_ids: (R,) capture ids.  Returns
     bool (D, R): True where every hash bit of ref r is set in sketch d — the
-    candidate matrix of the approximate strategies.  The contraction runs as a
-    bf16 matmul with f32 accumulation (counts <= num_hashes, exactly
-    representable).
+    candidate matrix of the approximate strategies.  The contraction runs in
+    the resolved cooc dtype: int8 with int32 accumulation by default (exact —
+    counts <= bits), bf16 with f32 accumulation as the fallback (counts <=
+    num_hashes, exactly representable).
 
     backend: "pallas" (packed fused kernel, default on TPU — see
     ops/pallas_kernels.py) or "jnp" (unpacked-planes formulation, default
@@ -190,24 +200,31 @@ def contains_matrix(sketch_tile, ref_ids, ref_valid, *, bits: int,
             # would hold; pin popc to an unreachable value instead.
             popc = jnp.pad(popc, (0, rp), constant_values=jnp.int32(-1))
         out = pallas_kernels.packed_contains_matrix(
-            sketch_tile, ref_packed, popc, interpret=interpret)
+            sketch_tile, ref_packed, popc, interpret=interpret,
+            unpack_dtype=_contract_dtype())
         return (out[:d, :r] == 1) & ref_valid[None, :]
     return _contains_matrix_jnp(sketch_tile, ref_ids, ref_valid, bits=bits,
-                                num_hashes=num_hashes)
+                                num_hashes=num_hashes,
+                                contract_dtype=_contract_dtype())
 
 
-@functools.partial(jax.jit, static_argnames=("bits", "num_hashes"))
+@functools.partial(jax.jit,
+                   static_argnames=("bits", "num_hashes", "contract_dtype"))
 def _contains_matrix_jnp(sketch_tile, ref_ids, ref_valid, *, bits: int,
-                         num_hashes: int):
+                         num_hashes: int, contract_dtype: str = "bf16"):
     planes = unpack_planes(sketch_tile)  # (D, bits)
     r = ref_ids.shape[0]
     pos = bit_positions(ref_ids, bits=bits, num_hashes=num_hashes)  # (R, k)
     ref_planes = jnp.zeros((r, bits), jnp.uint8)
     ref_planes = ref_planes.at[jnp.arange(r)[:, None], pos].max(jnp.uint8(1))
     popc = ref_planes.sum(axis=1, dtype=jnp.int32)  # <= k (hash collisions)
+    # contract_dtype is a STATIC jit key (the 0/1 planes' aval is uint8
+    # either way): a dtype flip must retrace, not reuse the other program.
+    dt = jnp.int8 if contract_dtype == "int8" else jnp.bfloat16
+    acc = jnp.int32 if contract_dtype == "int8" else jnp.float32
     hits = jax.lax.dot_general(
-        planes.astype(jnp.bfloat16), ref_planes.astype(jnp.bfloat16),
-        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        planes.astype(dt), ref_planes.astype(dt),
+        (((1,), (1,)), ((), ())), preferred_element_type=acc)
     return (hits.astype(jnp.int32) == popc[None, :]) & ref_valid[None, :]
 
 

@@ -802,6 +802,20 @@ def _emit_sinks(cfg: Config, phases: _Phases, counters: dict, table,
             f"{k[0]}/{k[1]}: {v}" for k, v in fams.items()), file=sys.stderr)
         counters.update({f"cinds-{k}": v for k, v in fams.items()})
 
+    if cfg.debug_level >= 1 and "dense_plan" in stats and _is_primary():
+        # Dense cooc occupancy: the roofline-correcting record (issued vs
+        # real FLOPs of the scheduled tile sweep) plus the resolved dtype.
+        dp = stats["dense_plan"]
+        print(f"dense plan: dtype={stats.get('cooc_dtype')} "
+              f"policy={dp['policy']} "
+              f"lines={dp['l_real']}/{dp['l_pad']} "
+              f"caps={dp['c_real']}/{dp['c_pad']} tile={dp['tile']} "
+              f"tiles={dp['n_tiles'] - dp['n_tiles_skipped']}"
+              f"/{dp['n_tiles']} occupancy={dp['occupancy']}",
+              file=sys.stderr)
+    elif cfg.debug_level >= 1 and "cooc_dtype" in stats and _is_primary():
+        print(f"cooc dtype: {stats['cooc_dtype']}", file=sys.stderr)
+
     if cfg.debug_level >= 1 and "n_host_syncs" in stats and _is_primary():
         # Dispatch telemetry of the pipelined pass executor (sharded runs):
         # proof the compute/readback overlap happened, not an assertion of it.

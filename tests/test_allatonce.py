@@ -173,15 +173,16 @@ def test_association_rules_hand_fixture():
 
 
 def test_engine_int8_membership_matches(monkeypatch):
-    """RDFIND_COOC_DTYPE=int8 (int8 membership + int32 accumulation on the
-    MXU) is bit-identical to the bf16 default, across every dense consumer.
-    The dtype rides the jit caches as a static key, so the flip genuinely
-    retraces (it is not served a stale bf16 program)."""
+    """int8 membership (int32 accumulation on the MXU — the default wherever
+    int8 matmul lowers) is bit-identical to the bf16 fallback, across every
+    dense consumer.  The dtype rides the jit caches as a static key, so the
+    flip genuinely retraces (it is not served a stale program)."""
     from rdfind_tpu.models import approximate, small_to_large
     from rdfind_tpu.ops import cooc
     from rdfind_tpu.utils.synth import generate_triples
 
     triples = generate_triples(800, seed=17, n_predicates=6, n_entities=64)
+    monkeypatch.setattr(cooc, "COOC_DTYPE", "bf16")
     want = allatonce.discover(triples, 2).to_rows()
     want_s2l = small_to_large.discover(triples, 2).to_rows()
     monkeypatch.setattr(cooc, "COOC_DTYPE", "int8")

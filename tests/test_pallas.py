@@ -1,7 +1,10 @@
 """Pallas packed-containment kernel vs. the jnp planes formulation.
 
 Runs the kernel in interpreter mode (CPU); the lowered TPU path is exercised by
-bench runs on the real chip.
+bench runs on the real chip.  Parity is checked for BOTH unpack dtypes (int8 —
+the default wherever int8 matmul lowers — and the bf16 fallback) under BOTH
+pltpu.repeat lane-order branches, with the matching repeat semantics emulated
+via monkeypatch so each shift formula is exercised on every jax version.
 """
 
 import numpy as np
@@ -19,10 +22,30 @@ def random_sketches(rng, n, bits):
     return rng.integers(0, 1 << 32, size=(n, bits // 32), dtype=np.uint32)
 
 
-@pytest.mark.parametrize("seed,bits", [(0, BITS), (1, BITS), (0, 8192)])
-def test_packed_kernel_matches_jnp(seed, bits):
-    # bits=8192 -> W=256 words > WK_MAX=128, exercising the K-grid accumulation
-    # (scratch init at k==0, finalize at k==nk-1) with nk=2.
+def force_repeat_order(monkeypatch, tile_order: bool):
+    """Pin the unpack's lane-order branch AND the matching repeat semantics.
+
+    _repeat_is_tile selects the shift formula; _repeat32 is the lane repeat
+    itself.  Forcing one without the other would (correctly) break parity —
+    the pair must agree, and emulating both orders with jnp.tile/jnp.repeat
+    makes each branch testable regardless of the installed pltpu semantics.
+    """
+    monkeypatch.setattr(pallas_kernels, "_repeat_is_tile", lambda: tile_order)
+    monkeypatch.setattr(
+        pallas_kernels, "_repeat32",
+        (lambda x: jnp.tile(x, (1, 32))) if tile_order
+        else (lambda x: jnp.repeat(x, 32, axis=1)))
+
+
+@pytest.mark.parametrize("tile_order", [True, False])
+@pytest.mark.parametrize("unpack_dtype", ["int8", "bf16"])
+@pytest.mark.parametrize("seed,bits", [(0, BITS), (1, BITS), (0, 16384)])
+def test_packed_kernel_matches_jnp(monkeypatch, seed, bits, unpack_dtype,
+                                   tile_order):
+    # bits=16384 -> W=512 words > both WK_MAX entries, exercising the K-grid
+    # accumulation (scratch init at k==0, finalize at k==nk-1) with nk >= 2
+    # plus the hoisted dep-plane chunk writes at dynamic K offsets.
+    force_repeat_order(monkeypatch, tile_order)
     rng = np.random.default_rng(seed)
     d, r = 128, 128
     sketches = random_sketches(rng, d, bits)
@@ -30,13 +53,34 @@ def test_packed_kernel_matches_jnp(seed, bits):
     valid = jnp.ones(r, bool)
     want = np.asarray(sketch._contains_matrix_jnp(
         jnp.asarray(sketches), ref_ids, valid, bits=bits, num_hashes=K))
-    got = np.asarray(sketch.contains_matrix(
-        jnp.asarray(sketches), ref_ids, valid, bits=bits, num_hashes=K,
-        backend="pallas", interpret=True))
-    np.testing.assert_array_equal(got, want)
+    ref_packed, popc = sketch.pack_ref_bits(ref_ids, bits=bits, num_hashes=K)
+    got = np.asarray(pallas_kernels.packed_contains_matrix(
+        jnp.asarray(sketches), ref_packed, popc, interpret=True,
+        unpack_dtype=unpack_dtype))
+    np.testing.assert_array_equal(got.astype(bool), want)
 
 
-def test_packed_kernel_padding_and_valid_mask():
+@pytest.mark.parametrize("unpack_dtype", ["int8", "bf16"])
+def test_packed_kernel_multi_tile_hoist(monkeypatch, unpack_dtype):
+    # Multiple dep AND ref tiles: the hoisted dep-plane scratch is filled at
+    # j == 0 and re-read for every later ref tile, so any staleness across
+    # the (i, j) revisit order shows up as off-tile mismatches.
+    rng = np.random.default_rng(5)
+    d, r = 256, 384
+    sketches = random_sketches(rng, d, BITS)
+    ref_ids = jnp.asarray(rng.integers(0, 500, size=r, dtype=np.int32))
+    valid = jnp.ones(r, bool)
+    want = np.asarray(sketch._contains_matrix_jnp(
+        jnp.asarray(sketches), ref_ids, valid, bits=BITS, num_hashes=K))
+    ref_packed, popc = sketch.pack_ref_bits(ref_ids, bits=BITS, num_hashes=K)
+    got = np.asarray(pallas_kernels.packed_contains_matrix(
+        jnp.asarray(sketches), ref_packed, popc, interpret=True,
+        unpack_dtype=unpack_dtype))
+    np.testing.assert_array_equal(got.astype(bool), want)
+
+
+@pytest.mark.parametrize("unpack_dtype", ["int8", "bf16"])
+def test_packed_kernel_padding_and_valid_mask(unpack_dtype):
     # Non-tile-aligned D/R exercise the pad + slice path; padded refs must not
     # produce phantom candidates, and ~valid refs are masked.
     rng = np.random.default_rng(7)
@@ -47,12 +91,30 @@ def test_packed_kernel_padding_and_valid_mask():
     ref_ids = jnp.asarray(rng.integers(0, 100, size=r, dtype=np.int32))
     valid = jnp.asarray(rng.integers(0, 2, size=r).astype(bool))
     want = np.asarray(sketch._contains_matrix_jnp(
-        jnp.asarray(sketches), ref_ids, valid, bits=BITS, num_hashes=K))
+        jnp.asarray(sketches), ref_ids, valid, bits=BITS, num_hashes=K,
+        contract_dtype=unpack_dtype))
     got = np.asarray(sketch.contains_matrix(
         jnp.asarray(sketches), ref_ids, valid, bits=BITS, num_hashes=K,
         backend="pallas", interpret=True))
     assert got.shape == want.shape == (d, r)
     np.testing.assert_array_equal(got, want)
+
+
+def test_contains_matrix_jnp_dtype_parity():
+    # The int8 (int32-accumulated) and bf16 (f32-accumulated) contractions of
+    # the planes formulation are bit-identical — the exactness claim behind
+    # int8-by-default.
+    rng = np.random.default_rng(9)
+    sketches = jnp.asarray(random_sketches(rng, 96, BITS))
+    ref_ids = jnp.asarray(rng.integers(0, 300, size=96, dtype=np.int32))
+    valid = jnp.ones(96, bool)
+    a = np.asarray(sketch._contains_matrix_jnp(
+        sketches, ref_ids, valid, bits=BITS, num_hashes=K,
+        contract_dtype="int8"))
+    b = np.asarray(sketch._contains_matrix_jnp(
+        sketches, ref_ids, valid, bits=BITS, num_hashes=K,
+        contract_dtype="bf16"))
+    np.testing.assert_array_equal(a, b)
 
 
 def test_pack_ref_bits_matches_planes():
@@ -71,3 +133,7 @@ def test_tile_alignment_validation():
     z = jnp.zeros((100, 8), jnp.uint32)
     with pytest.raises(ValueError):
         pallas_kernels.packed_contains_matrix(z, z, jnp.zeros(100, jnp.int32))
+    with pytest.raises(ValueError):
+        pallas_kernels.packed_contains_matrix(
+            jnp.zeros((128, 8), jnp.uint32), jnp.zeros((128, 8), jnp.uint32),
+            jnp.zeros(128, jnp.int32), unpack_dtype="f64")
