@@ -231,7 +231,14 @@ def _bench_pipelined_passes(min_support: int) -> dict:
                 **{k: stats.get(k) for k in (
                     "n_pair_passes", "n_passes_in_flight", "n_host_syncs",
                     "host_sync_ms", "pull_overlap_ms", "n_pair_cap_retries",
-                    "cap_p_final")},
+                    "cap_p_final",
+                    # Fault-domain telemetry (PR 3): ladder + retry/backoff
+                    # counters prove a degraded run degraded, and a clean one
+                    # didn't, straight from the artifact.
+                    "n_overflow_retries", "n_host_pull_retries",
+                    "backoff_ms_total")},
+                "degradations": stats.get("degradations"),
+                "ladder_rung": stats.get("ladder_rung"),
                 "cinds": len(tables[mode]),
             }
         out.update(rows)
@@ -306,6 +313,8 @@ def _run(n: int, min_support: int) -> dict:
         # and the dense plan's real/issued-FLOP record for THIS workload.
         "cooc_dtype": stats.get("cooc_dtype"),
         "dense_plan": stats.get("dense_plan"),
+        # Degradation ledger of the headline run (None on a fault-free run).
+        "degradations": stats.get("degradations"),
         "oracle_wall_s": round(oracle_elapsed, 3),
         "oracle_pairs_per_sec": round(oracle_pairs_per_sec, 1),
     }

@@ -69,6 +69,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import hashlib
 import os
 import sys
 
@@ -84,7 +85,7 @@ from ..ops.emission import emit_join_candidates
 from ..parallel import exchange
 from ..parallel.mesh import (AXIS, host_gather, host_gather_many, make_global,
                              make_mesh, shard_map)
-from ..runtime import dispatch
+from ..runtime import dispatch, faults
 
 SENTINEL = segments.SENTINEL
 
@@ -702,6 +703,15 @@ def _headroom(measured: int, floor: int = CAP_FLOOR) -> int:
                                       floor))
 
 
+class _PairCapsExhausted(Exception):
+    """Internal ladder signal: a pass exhausted its grow retries (the
+    executor escalates to split / fallback; never escapes _run_passes)."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg)
+        self.msg = msg
+
+
 class _Pipeline:
     """Planned, retrying execution of the sharded programs (host side).
 
@@ -712,7 +722,7 @@ class _Pipeline:
 
     def __init__(self, mesh, triples, min_support, projections, use_fis,
                  use_ars, max_retries, stats, skew=None, combine=True,
-                 preshard=None):
+                 preshard=None, progress=None):
         self.mesh = mesh
         self.num_dev = mesh.devices.size
         self.min_support = min_support
@@ -720,6 +730,14 @@ class _Pipeline:
         self.stats = stats
         self.skew = skew if skew is not None else DEFAULT_SKEW
         self.combine = combine
+        # Preemption-safe per-pass checkpoints (checkpoint.ProgressStore, or
+        # None): each _run_passes phase snapshots committed passes through it.
+        self.progress = progress
+        self._phase_seq = 0
+        # Pull-retry telemetry baseline: the pipeline's planning/line pulls
+        # run before any DispatchStats exists, so the executor publishes the
+        # delta since THIS point (pipeline lifetime, not executor lifetime).
+        self._pull_base = faults.pull_stats()
         if preshard is not None:
             # Pre-built global arrays (sharded multi-host ingest:
             # runtime/multihost_ingest.py) — no host ever held the full table.
@@ -745,8 +763,11 @@ class _Pipeline:
                 skew=self.skew, combine=self.combine)
             *line_cols, n_rows, plan, overflow = out
             ovf = host_gather(overflow).reshape(self.num_dev, 2)[0]
+            if faults.overflow_injected("overflow@lines"):
+                ovf = np.maximum(ovf, 1)
             if int(ovf.sum()) == 0:
                 break
+            self._count_overflow_retry("line-building")
             if ovf[0] > 0:
                 self.cap_f = segments.pow2_capacity(2 * self.cap_f + int(ovf[0]))
             if ovf[1] > 0:
@@ -754,9 +775,9 @@ class _Pipeline:
             _check_exchange_caps(self.num_dev, freq=self.cap_f,
                                  exchange_a=self.cap_a)
         else:
-            raise RuntimeError(
-                f"line-building overflow persisted after {max_retries} retries "
-                f"(freq={int(ovf[0])}, exchange_a={int(ovf[1])})")
+            self._overflow_exhausted(
+                "line-building",
+                f"freq={int(ovf[0])}, exchange_a={int(ovf[1])}")
         self.lines = line_cols  # jv, code, v1, v2 — device-resident
         self.n_rows = n_rows
         plan = host_gather(plan).reshape(self.num_dev, 4)[0]
@@ -796,21 +817,25 @@ class _Pipeline:
                                  cap_exchange_b=self.cap_b)
             *tbl, n_caps, ovf_b = out
             ovf_b = int(host_gather(ovf_b)[0])
+            if faults.overflow_injected("overflow@captures"):
+                ovf_b = max(ovf_b, 1)
             if ovf_b == 0:
                 break
+            self._count_overflow_retry("capture-count")
             self.cap_b = segments.pow2_capacity(2 * self.cap_b + ovf_b)
             _check_caps(exchange_b=self.num_dev * self.cap_b)
         else:
-            raise RuntimeError(
-                f"capture-count overflow persisted after {max_retries} retries "
-                f"(exchange_b={ovf_b})")
+            self._overflow_exhausted("capture-count", f"exchange_b={ovf_b}")
         self.tbl = tbl  # tc, tv1, tv2, tcnt — device-resident, capture-owned
         self.n_caps = n_caps
+        # The PLAN-time capacities (deterministic per workload+config, unlike
+        # the grown retry caps) — part of every progress fingerprint.
+        self._planned_caps = dict(
+            freq=self.cap_f, exchange_a=self.cap_a, exchange_b=self.cap_b,
+            pairs=self.cap_p, exchange_c=self.cap_c, giant_rows=self.cap_g,
+            giant_pairs=self.cap_gp)
         if stats is not None:
-            stats["planned_caps"] = dict(
-                freq=self.cap_f, exchange_a=self.cap_a, exchange_b=self.cap_b,
-                pairs=self.cap_p, exchange_c=self.cap_c, giant_rows=self.cap_g,
-                giant_pairs=self.cap_gp)
+            stats["planned_caps"] = dict(self._planned_caps)
             # The sketch/containment stages (sharded strategies 2/3) contract
             # in the resolved cooc dtype; record it for bench/debug parity
             # with the single-chip strategies.
@@ -880,15 +905,45 @@ class _Pipeline:
                                   mesh=self.mesh, cap_move=cap_move)
             *cols, n_rows, ovf = out
             ovf = int(host_gather(ovf)[0])
+            if faults.overflow_injected("overflow@rebalance"):
+                ovf = max(ovf, 1)
             if ovf == 0:
                 break
+            self._count_overflow_retry("rebalance")
             cap_move = segments.pow2_capacity(2 * cap_move + ovf)
         else:
-            raise RuntimeError(
-                f"rebalance overflow persisted after {self.max_retries} "
-                f"retries ({ovf})")
+            # Ladder rung "skip": rebalancing is an output-neutral placement
+            # optimization (exchanges B/C route by capture hash either way),
+            # so the cheapest safe degradation is to keep hash placement.
+            if faults.strict_mode():
+                raise RuntimeError(
+                    f"rebalance overflow persisted after {self.max_retries} "
+                    f"retries ({ovf})")
+            faults.record_degradation(self.stats, "rebalance", "skip",
+                                      overflow=int(ovf))
+            if self.stats is not None:
+                self.stats["rebalance"]["moved_lines"] = 0
+            return
         self.lines = cols
         self.n_rows = n_rows
+
+    def _count_overflow_retry(self, phase: str) -> None:
+        """Ledger + telemetry for one capacity-grow retry (ladder rung 0)."""
+        if self.stats is not None:
+            self.stats["n_overflow_retries"] = (
+                self.stats.get("n_overflow_retries", 0) + 1)
+        faults.record_degradation(self.stats, phase, "grow")
+
+    def _overflow_exhausted(self, phase: str, detail: str):
+        """Grow retries exhausted with no further rung for this phase: strict
+        mode keeps the historical fail-fast RuntimeError; otherwise escalate
+        straight to the single-device fallback (the discover entry points
+        catch FallbackRequired and re-run with identical output)."""
+        msg = (f"{phase} overflow persisted after {self.max_retries} retries "
+               f"({detail})")
+        if faults.strict_mode():
+            raise RuntimeError(msg)
+        raise faults.FallbackRequired(phase, detail)
 
     def _pair_caps(self):
         return dict(cap_pairs=self.cap_p, cap_exchange_c=self.cap_c,
@@ -955,12 +1010,27 @@ class _Pipeline:
     def _pass_args(self, p: int):
         return (jnp.full(1, p, jnp.int32), jnp.full(1, self.n_pass, jnp.int32))
 
-    def _run_passes(self, step, what: str):
+    def _run_passes(self, step, what: str, *, site: str = "cind",
+                    phase_key: str | None = None, fp_extra=None):
         """Pipelined dep-slice pass executor — the shared scaffolding of
         run_cinds and run_cooc.  `step(pass_args)` must return device arrays
         (cols, n_out, telemetry) with telemetry an exchange.pack_counters
         lane array of _TELE_LANES scalars whose first _N_OVF lanes are the
         overflow counters.
+
+        Fault-domain hardening on top of the pipelined schedule:
+
+          * every pass verdict carries the `overflow@{site}` injection gate
+            and every commit the `preempt@discover` gate (runtime/faults);
+          * exhausted grow retries escalate the degradation ladder instead of
+            dying: double n_pass + shrink per-pass caps (up to
+            RDFIND_MAX_PASS_SPLITS times), then FallbackRequired — the
+            discover entry point re-runs single-device with identical
+            output.  RDFIND_STRICT=1 keeps the historical RuntimeError;
+          * with a ProgressStore attached, each committed pass's host blocks
+            are snapshotted asynchronously (atomic + fsynced off the
+            critical path) and a preempted run's successor replays only the
+            unfinished passes (stats["resumed_passes"]).
 
         Schedule: pass p+1's jitted step is enqueued as soon as pass p's is
         (up to dispatch.pass_depth() passes in flight), the packed telemetry
@@ -985,19 +1055,83 @@ class _Pipeline:
         concatenate directly.  Returns (host blocks, tail counters transposed
         to per-counter tuples of ints); publishes dispatch telemetry into
         self.stats."""
-        d = dispatch.DispatchStats()
+        phase_key = phase_key or site
+        seq = self._phase_seq
+        self._phase_seq += 1
+        n_splits = 0
+        while True:
+            try:
+                return self._attempt_passes(step, what, site, phase_key, seq,
+                                            fp_extra)
+            except _PairCapsExhausted as e:
+                if faults.strict_mode():
+                    raise RuntimeError(e.msg) from None
+                if n_splits < faults.max_pass_splits():
+                    # Ladder rung "split": double the dep-slice pass count so
+                    # each pass carries ~half the load, shrink the per-pass
+                    # buffers to match, and re-run the phase from scratch
+                    # (completed parts of THIS attempt partition differently
+                    # under the new n_pass and cannot be reused).
+                    n_splits += 1
+                    faults.record_degradation(self.stats, what, "split",
+                                              n_pass=self.n_pass * 2)
+                    self.n_pass *= 2
+                    self.cap_p = max(
+                        segments.pow2_capacity(self.cap_p // 2), 1 << 10)
+                    self.cap_gp = max(
+                        segments.pow2_capacity(self.cap_gp // 2), 1 << 10)
+                    self.cap_c = _headroom(
+                        (self.cap_p + self.cap_gp) // max(self.num_dev, 1),
+                        floor=1 << 10)
+                    self._check_pair_caps()
+                    if self.stats is not None:
+                        self.stats["n_pair_passes"] = self.n_pass
+                    continue
+                raise faults.FallbackRequired(what, e.msg) from None
+
+    def _attempt_passes(self, step, what, site, phase_key, seq, fp_extra):
+        """One ladder attempt of the pipelined pass loop at the current
+        n_pass/caps (see _run_passes for the schedule contract)."""
+        d = dispatch.DispatchStats(pull_base=self._pull_base)
         parts = [None] * self.n_pass
         teles = [None] * self.n_pass
         tries = [0] * self.n_pass
+        stage = fp = None
+        # Single-process only: resuming a pass from a host-local snapshot
+        # while a peer host misses it would skip this host's half of the
+        # collectives and deadlock the mesh (the discover-stage resume
+        # solves this with an all-hosts-agree vote; per-pass agreement is
+        # future work, so multi-host runs keep stage-boundary resume only).
+        progress = self.progress if jax.process_count() == 1 else None
+        if progress is not None:
+            stage, fp = progress.phase_fp(
+                phase_key, seq, n_pass=self.n_pass, num_dev=self.num_dev,
+                extra=dict(what=what, min_support=int(self.min_support),
+                           caps=self._planned_caps, **(fp_extra or {})))
+            done = progress.load(stage, fp)
+            if done:
+                for p, (blocks_p, tele_p) in done.items():
+                    if 0 <= p < self.n_pass:
+                        parts[p] = list(blocks_p)
+                        teles[p] = tele_p
+                if self.stats is not None:
+                    self.stats["resumed_passes"] = (
+                        self.stats.get("resumed_passes", 0)
+                        + sum(1 for x in parts if x is not None))
         depth = dispatch.pass_depth()
         inflight = collections.deque()  # (p, cols, n_out, telemetry)
         p_next = 0
         while p_next < self.n_pass or inflight:
             while p_next < self.n_pass and len(inflight) < depth:
+                if parts[p_next] is not None:  # resumed from a checkpoint
+                    p_next += 1
+                    continue
                 cols, n_out, tele = step(self._pass_args(p_next))
                 dispatch.stage_to_host([tele])
                 inflight.append((p_next, cols, n_out, tele))
                 p_next += 1
+            if not inflight:
+                break  # everything left was already resumed
             d.saw_in_flight(len(inflight))
             p, cols, n_out, tele = inflight.popleft()
             tele_h = d.timed_pull(
@@ -1005,12 +1139,17 @@ class _Pipeline:
                                                  _TELE_LANES, self.num_dev),
                 overlapped=bool(inflight))
             ovf = tele_h[:_N_OVF]
+            if faults.overflow_injected(f"overflow@{site}", pass_idx=p):
+                ovf = np.maximum(np.asarray(ovf), 1)
             if int(ovf.sum()) != 0:
                 tries[p] += 1
                 if tries[p] >= self.max_retries:
-                    raise RuntimeError(
+                    if self.stats is not None:
+                        d.publish(self.stats)  # keep telemetry across rungs
+                    raise _PairCapsExhausted(
                         f"{what} overflow persisted after {self.max_retries} "
-                        f"retries ({ovf.tolist()})")
+                        f"retries ({np.asarray(ovf).tolist()})")
+                self._count_overflow_retry(what)
                 inflight.clear()  # discard optimistically dispatched successors
                 self._grow_pair_caps(ovf)
                 d.n_cap_retries += 1
@@ -1019,6 +1158,17 @@ class _Pipeline:
             parts[p] = d.timed_pull(lambda: self.collect_blocks(cols, n_out),
                                     overlapped=bool(inflight))
             teles[p] = tuple(int(x) for x in tele_h[_N_OVF:])
+            if progress is not None:
+                # Cumulative snapshot of every committed pass, written by a
+                # worker thread (atomic + fsynced) while successors compute.
+                progress.submit(stage, fp, {
+                    i: (parts[i], teles[i]) for i in range(self.n_pass)
+                    if parts[i] is not None})
+            if faults.fires("preempt@discover", pass_idx=p):
+                if progress is not None:
+                    progress.flush()  # the SIGTERM handler's analog
+                raise faults.Preempted(
+                    f"injected preemption after {what} pass {p}")
         blocks = [np.concatenate([part[i] for part in parts])
                   for i in range(len(parts[0]))]
         if self.stats is not None:
@@ -1035,7 +1185,9 @@ class _Pipeline:
             *cols, n_out, tele = out
             return cols, n_out, tele
 
-        blocks, (ngl, ngp, _) = self._run_passes(step, "pair-phase")
+        blocks, (ngl, ngp, _) = self._run_passes(step, "pair-phase",
+                                                 site="cind",
+                                                 phase_key="cind")
         if self.stats is not None:
             # max across passes: a mid-run cap_p growth shifts the giant
             # threshold between passes, so the last pass may see fewer giants
@@ -1053,7 +1205,14 @@ class _Pipeline:
             *cols, n_out, tele = out
             return cols, n_out, tele
 
-        blocks, (ngl, ngp, npt) = self._run_passes(step, "sharded S2L cooc")
+        # The level's flag table is part of the phase identity: a progress
+        # snapshot from one lattice level must never satisfy another.
+        digest = hashlib.sha256(b"".join(
+            np.ascontiguousarray(a).tobytes()
+            for a in (fcode, fv1, fv2, fflag, n_flags))).hexdigest()
+        blocks, (ngl, ngp, npt) = self._run_passes(
+            step, "sharded S2L cooc", site="cooc", phase_key=stat_key,
+            fp_extra={"flags": digest})
         if self.stats is not None:
             self.stats[stat_key] = sum(npt)
             self.stats["total_pairs"] = (self.stats.get("total_pairs", 0)
@@ -1065,13 +1224,60 @@ class _Pipeline:
         return blocks
 
 
+def _gather_preshard_triples(preshard) -> np.ndarray:
+    """Host triple table from a preshard's global arrays.
+
+    The fallback rung trades the no-host-table property for completing the
+    run at all — at fallback scale (a workload one chip can finish) the
+    gathered table fits the host by construction.
+    """
+    g_triples, g_valid = preshard
+    t = np.asarray(host_gather(g_triples)).reshape(-1, 3)
+    nv = np.asarray(host_gather(g_valid)).reshape(-1)
+    block = t.shape[0] // max(nv.shape[0], 1)
+    keep = np.zeros(t.shape[0], bool)
+    for dev in range(nv.shape[0]):
+        keep[dev * block: dev * block + int(nv[dev])] = True
+    return t[keep]
+
+
+def _single_device_fallback(kind: str, exc, triples, preshard, min_support,
+                            projections, use_fis, use_ars, clean_implied,
+                            stats, **kwargs) -> CindTable:
+    """The degradation ladder's last rung: re-run the workload on this
+    strategy family's output-identical single-device implementation (the
+    reference's driver-side shape; SmallToLarge is the default family, and
+    each sharded strategy falls back to its own twin so the CIND table stays
+    bit-identical to a fault-free run)."""
+    from . import allatonce, approximate, late_bb, small_to_large
+
+    fn = {"allatonce": allatonce.discover,
+          "small_to_large": small_to_large.discover,
+          "approximate": approximate.discover,
+          "late_bb": late_bb.discover}[kind]
+    print(f"rdfind: sharded {exc.phase} could not complete ({exc.detail}); "
+          f"degrading to the single-device {kind} strategy",
+          file=sys.stderr)
+    faults.record_degradation(stats, exc.phase, "fallback", strategy=kind,
+                              reason=exc.detail)
+    if triples is None and preshard is not None:
+        triples = _gather_preshard_triples(preshard)
+    if triples is None or np.asarray(triples).shape[0] == 0:
+        return CindTable.empty()
+    return fn(np.asarray(triples, np.int32), min_support,
+              projections=projections,
+              use_frequent_condition_filter=use_fis,
+              use_association_rules=use_ars,
+              clean_implied=clean_implied, stats=stats, **kwargs)
+
+
 def discover_sharded(triples, min_support: int, mesh=None, projections: str = "spo",
                      use_fis: bool = False, use_ars: bool = False,
                      clean_implied: bool = False,
                      max_retries: int = 4, stats: dict | None = None,
                      skew: SkewPolicy | None = None,
                      combine: bool = True,
-                     preshard=None) -> CindTable:
+                     preshard=None, progress=None) -> CindTable:
     """Discover all CINDs with the full AllAtOnce step sharded over `mesh`.
 
     Output is identical to models.allatonce.discover with matching flags.  If
@@ -1094,10 +1300,16 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
     min_support = max(int(min_support), 1)
     use_ars = use_ars and use_fis
 
-    pipe = _Pipeline(mesh, triples, min_support, projections, use_fis, use_ars,
-                     max_retries, stats, skew=skew, combine=combine,
-                     preshard=preshard)
-    d_code, d_v1, d_v2, r_code, r_v1, r_v2, support = pipe.run_cinds()
+    try:
+        pipe = _Pipeline(mesh, triples, min_support, projections, use_fis,
+                         use_ars, max_retries, stats, skew=skew,
+                         combine=combine, preshard=preshard,
+                         progress=progress)
+        d_code, d_v1, d_v2, r_code, r_v1, r_v2, support = pipe.run_cinds()
+    except faults.FallbackRequired as e:
+        return _single_device_fallback(
+            "allatonce", e, triples, preshard, min_support, projections,
+            use_fis, use_ars, clean_implied, stats)
 
     table = CindTable(
         dep_code=d_code.astype(np.int64), dep_v1=d_v1.astype(np.int64),
@@ -1338,12 +1550,13 @@ def _mine_rules(triples, preshard, min_support, mesh):
 
 def _sharded_prep_approx(triples, min_support, mesh, projections, use_fis,
                          use_ars, max_retries, sketch_bits, sketch_hashes,
-                         stats, skew=None, combine=True, preshard=None):
+                         stats, skew=None, combine=True, preshard=None,
+                         progress=None):
     """Shared setup for sharded strategies 2/3: pipeline, frequent-capture
     table, sketch candidates, and the sharded verification backend."""
     pipe = _Pipeline(mesh, triples, min_support, projections, use_fis, use_ars,
                      max_retries, stats, skew=skew, combine=combine,
-                     preshard=preshard)
+                     preshard=preshard, progress=progress)
     cap_code, cap_v1, cap_v2, dep_count = pipe.capture_table()
     freq_cap = dep_count >= min_support
     cap_table = tuple(a[freq_cap] for a in (cap_code, cap_v1, cap_v2,
@@ -1389,7 +1602,7 @@ def discover_sharded_approx(triples, min_support: int, mesh=None,
                             stats: dict | None = None,
                             skew: SkewPolicy | None = None,
                             combine: bool = True,
-                         preshard=None) -> CindTable:
+                         preshard=None, progress=None) -> CindTable:
     """Sharded ApproximateAllAtOnce (strategy 2): mesh-tiled sketch containment
     for candidates, exact sharded counting for verification.  Output is
     identical to models.approximate.discover (= raw AllAtOnce)."""
@@ -1404,17 +1617,24 @@ def discover_sharded_approx(triples, min_support: int, mesh=None,
         return CindTable.empty()
     min_support = max(int(min_support), 1)
 
-    prep = _sharded_prep_approx(triples, min_support, mesh, projections,
-                                use_fis, use_ars, max_retries, sketch_bits,
-                                sketch_hashes, stats, skew=skew,
-                                combine=combine, preshard=preshard)
-    if prep is None:
-        return CindTable.empty()
-    cap_table, cand_dep, cand_ref, backend = prep
-    cap_code, cap_v1, cap_v2, dep_count = cap_table
-    d, r, sup = small_to_large._verify_level(
-        backend.cooc, cand_dep, cand_ref, cap_code.shape[0], dep_count,
-        cap_code, cap_v1, cap_v2, min_support, "pairs_verify")
+    try:
+        prep = _sharded_prep_approx(triples, min_support, mesh, projections,
+                                    use_fis, use_ars, max_retries, sketch_bits,
+                                    sketch_hashes, stats, skew=skew,
+                                    combine=combine, preshard=preshard,
+                                    progress=progress)
+        if prep is None:
+            return CindTable.empty()
+        cap_table, cand_dep, cand_ref, backend = prep
+        cap_code, cap_v1, cap_v2, dep_count = cap_table
+        d, r, sup = small_to_large._verify_level(
+            backend.cooc, cand_dep, cand_ref, cap_code.shape[0], dep_count,
+            cap_code, cap_v1, cap_v2, min_support, "pairs_verify")
+    except faults.FallbackRequired as e:
+        return _single_device_fallback(
+            "approximate", e, triples, preshard, min_support, projections,
+            use_fis, use_ars, clean_implied, stats,
+            sketch_bits=sketch_bits, sketch_hashes=sketch_hashes)
     return _finish_table(cap_table, d, r, sup, triples, min_support, use_ars,
                          clean_implied, stats, mesh=mesh, preshard=preshard)
 
@@ -1427,7 +1647,7 @@ def discover_sharded_late_bb(triples, min_support: int, mesh=None,
                              stats: dict | None = None,
                             skew: SkewPolicy | None = None,
                             combine: bool = True,
-                         preshard=None) -> CindTable:
+                         preshard=None, progress=None) -> CindTable:
     """Sharded LateBB (strategy 3): one mesh-tiled sketch pass, then the
     unary-dependent round and the 1/x-pruned binary round verify on the mesh.
     Output is identical to models.late_bb.discover."""
@@ -1442,26 +1662,34 @@ def discover_sharded_late_bb(triples, min_support: int, mesh=None,
         return CindTable.empty()
     min_support = max(int(min_support), 1)
 
-    prep = _sharded_prep_approx(triples, min_support, mesh, projections,
-                                use_fis, use_ars, max_retries, sketch_bits,
-                                sketch_hashes, stats, skew=skew,
-                                combine=combine, preshard=preshard)
-    if prep is None:
-        return CindTable.empty()
-    cap_table, cand_dep, cand_ref, backend = prep
-    cap_code, cap_v1, cap_v2, dep_count = cap_table
-    num_caps = cap_code.shape[0]
-    dep_is_unary = np.asarray(cc.is_unary(cap_code))[cand_dep]
+    try:
+        prep = _sharded_prep_approx(triples, min_support, mesh, projections,
+                                    use_fis, use_ars, max_retries, sketch_bits,
+                                    sketch_hashes, stats, skew=skew,
+                                    combine=combine, preshard=preshard,
+                                    progress=progress)
+        if prep is None:
+            return CindTable.empty()
+        cap_table, cand_dep, cand_ref, backend = prep
+        cap_code, cap_v1, cap_v2, dep_count = cap_table
+        num_caps = cap_code.shape[0]
+        dep_is_unary = np.asarray(cc.is_unary(cap_code))[cand_dep]
 
-    d1, r1, sup1 = small_to_large._verify_level(
-        backend.cooc, cand_dep[dep_is_unary], cand_ref[dep_is_unary], num_caps,
-        dep_count, cap_code, cap_v1, cap_v2, min_support, "pairs_round1")
-    c2_dep, c2_ref = cand_dep[~dep_is_unary], cand_ref[~dep_is_unary]
-    keep = small_to_large._prune_22_vs_12(c2_dep, c2_ref, d1, r1,
-                                          cap_code, cap_v1, cap_v2)
-    d2, r2, sup2 = small_to_large._verify_level(
-        backend.cooc, c2_dep[keep], c2_ref[keep], num_caps, dep_count,
-        cap_code, cap_v1, cap_v2, min_support, "pairs_round2")
+        d1, r1, sup1 = small_to_large._verify_level(
+            backend.cooc, cand_dep[dep_is_unary], cand_ref[dep_is_unary],
+            num_caps, dep_count, cap_code, cap_v1, cap_v2, min_support,
+            "pairs_round1")
+        c2_dep, c2_ref = cand_dep[~dep_is_unary], cand_ref[~dep_is_unary]
+        keep = small_to_large._prune_22_vs_12(c2_dep, c2_ref, d1, r1,
+                                              cap_code, cap_v1, cap_v2)
+        d2, r2, sup2 = small_to_large._verify_level(
+            backend.cooc, c2_dep[keep], c2_ref[keep], num_caps, dep_count,
+            cap_code, cap_v1, cap_v2, min_support, "pairs_round2")
+    except faults.FallbackRequired as e:
+        return _single_device_fallback(
+            "late_bb", e, triples, preshard, min_support, projections,
+            use_fis, use_ars, clean_implied, stats,
+            sketch_bits=sketch_bits, sketch_hashes=sketch_hashes)
     if stats is not None:
         stats.update(n_round1_cinds=len(d1), n_round2_cinds=len(d2))
     return _finish_table(
@@ -1477,7 +1705,7 @@ def discover_sharded_s2l(triples, min_support: int, mesh=None,
                          stats: dict | None = None,
                          skew: SkewPolicy | None = None,
                          combine: bool = True,
-                         preshard=None) -> CindTable:
+                         preshard=None, progress=None) -> CindTable:
     """Sharded SmallToLarge: the reference's default strategy on the mesh.
 
     Join lines are built once and stay device-resident; the host drives the
@@ -1496,34 +1724,41 @@ def discover_sharded_s2l(triples, min_support: int, mesh=None,
         return CindTable.empty()
     min_support = max(int(min_support), 1)
 
-    pipe = _Pipeline(mesh, triples, min_support, projections, use_fis, use_ars,
-                     max_retries, stats, skew=skew, combine=combine,
-                     preshard=preshard)
-    cap_code, cap_v1, cap_v2, dep_count = pipe.capture_table()
-    # Frequent captures only (the single-device capture filter; infrequent ones
-    # can appear in no CIND on either side).
-    freq_cap = dep_count >= min_support
-    cap_code, cap_v1, cap_v2, dep_count = (
-        a[freq_cap] for a in (cap_code, cap_v1, cap_v2, dep_count))
-    num_caps = cap_code.shape[0]
-    if num_caps == 0:
-        return CindTable.empty()
+    try:
+        pipe = _Pipeline(mesh, triples, min_support, projections, use_fis,
+                         use_ars, max_retries, stats, skew=skew,
+                         combine=combine, preshard=preshard,
+                         progress=progress)
+        cap_code, cap_v1, cap_v2, dep_count = pipe.capture_table()
+        # Frequent captures only (the single-device capture filter; infrequent
+        # ones can appear in no CIND on either side).
+        freq_cap = dep_count >= min_support
+        cap_code, cap_v1, cap_v2, dep_count = (
+            a[freq_cap] for a in (cap_code, cap_v1, cap_v2, dep_count))
+        num_caps = cap_code.shape[0]
+        if num_caps == 0:
+            return CindTable.empty()
 
-    if stats is not None:
-        n_triples = (triples.shape[0] if preshard is None
-                     else int(host_gather(pipe._n_valid).sum()))
-        stats.update(n_triples=n_triples, n_captures=num_caps, total_pairs=0)
+        if stats is not None:
+            n_triples = (triples.shape[0] if preshard is None
+                         else int(host_gather(pipe._n_valid).sum()))
+            stats.update(n_triples=n_triples, n_captures=num_caps,
+                         total_pairs=0)
 
-    backend = _ShardedCooc(pipe, (cap_code, cap_v1, cap_v2, dep_count))
+        backend = _ShardedCooc(pipe, (cap_code, cap_v1, cap_v2, dep_count))
 
-    rules = (_mine_rules(triples, preshard, min_support, pipe.mesh)
-             if use_ars else None)
-    if use_ars and stats is not None:
-        stats["association_rules"] = rules
+        rules = (_mine_rules(triples, preshard, min_support, pipe.mesh)
+                 if use_ars else None)
+        if use_ars and stats is not None:
+            stats["association_rules"] = rules
 
-    return small_to_large._run_lattice(
-        backend.cooc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
-        min_support, use_ars, rules, clean_implied, stats, mesh=pipe.mesh)
+        return small_to_large._run_lattice(
+            backend.cooc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
+            min_support, use_ars, rules, clean_implied, stats, mesh=pipe.mesh)
+    except faults.FallbackRequired as e:
+        return _single_device_fallback(
+            "small_to_large", e, triples, preshard, min_support, projections,
+            use_fis, use_ars, clean_implied, stats)
 
 
 @functools.lru_cache(maxsize=None)

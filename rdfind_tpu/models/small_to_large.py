@@ -48,7 +48,7 @@ from .. import conditions as cc
 from ..data import NO_VALUE, CindTable
 from ..ops import cooc as cooc_ops
 from ..ops import frequency, minimality, pairs, segments, sketch
-from ..runtime import dispatch
+from ..runtime import dispatch, faults
 from . import allatonce
 
 SENTINEL = segments.SENTINEL
@@ -129,7 +129,9 @@ def _iter_chunk_pairs(line_val_h, line_cap_h, dep_ok, ref_ok, budget,
     pipelined = not dispatch.sync_passes_forced()
 
     def pull(chunk):
-        d, r, c, n_out = jax.device_get(chunk)  # ONE batched round trip
+        # ONE batched round trip, through the host_pull fault gate + bounded
+        # backoff retry (pure read: re-pulling a chunk is always safe).
+        d, r, c, n_out = faults.guarded_pull(lambda: jax.device_get(chunk))
         m = int(n_out)
         return (d[:m].astype(np.int64), r[:m].astype(np.int64),
                 c[:m].astype(np.int64))

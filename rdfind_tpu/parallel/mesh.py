@@ -113,18 +113,28 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (AXIS,))
 
 
+def _host_gather_raw(x) -> np.ndarray:
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def host_gather(x) -> np.ndarray:
     """Device output -> host numpy, valid on every process.
 
     Single-process: a plain transfer.  Multi-process: shard_map outputs over
     P(AXIS) are globally sharded and not fully addressable from one host, so
     gather them with process_allgather (one DCN collective).
-    """
-    if jax.process_count() == 1:
-        return np.asarray(x)
-    from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    Pulls are pure reads of device state, so every gather rides the fault
+    gate + bounded backoff retry of runtime/faults.guarded_pull (the
+    `host_pull` injection site; RDFIND_STRICT=1 fails fast).
+    """
+    from ..runtime import faults
+
+    return faults.guarded_pull(lambda: _host_gather_raw(x))
 
 
 def host_gather_many(xs) -> list:
@@ -134,11 +144,14 @@ def host_gather_many(xs) -> list:
     (pair it with dispatch.stage_to_host so the copies were already in
     flight).  Multi-process each array still needs its own allgather
     collective, but issuing them back-to-back keeps the DCN pipe busy.
+    Counts as ONE host_pull fault-site hit either way (one round trip).
     """
+    from ..runtime import faults
+
     xs = list(xs)
     if jax.process_count() == 1:
-        return jax.device_get(xs)
-    return [host_gather(x) for x in xs]
+        return faults.guarded_pull(lambda: jax.device_get(xs))
+    return faults.guarded_pull(lambda: [_host_gather_raw(x) for x in xs])
 
 
 def make_global(host_array: np.ndarray, mesh: Mesh) -> jax.Array:

@@ -144,8 +144,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-native-ingest", action="store_true",
                    help="force the pure-Python ingest path")
     p.add_argument("--checkpoint-dir", default=None,
-                   help="directory for stage-boundary checkpoints; re-runs "
-                        "with unchanged inputs/flags resume from them")
+                   help="directory for stage-boundary AND mid-discover "
+                        "per-pass checkpoints; re-runs with unchanged "
+                        "inputs/flags resume from them (a preempted sharded "
+                        "discover replays only its unfinished passes).  "
+                        "Fault-tolerance knobs ride the environment: "
+                        "RDFIND_STRICT=1 fail-fast, RDFIND_FAULTS=... "
+                        "deterministic fault injection (see README, 'Fault "
+                        "tolerance & resume')")
     return p
 
 
@@ -264,7 +270,17 @@ def main(argv=None) -> int:
         if v not in (default, None):
             print(f"note: --{name.replace('_', '-')} has no effect ({why})",
                   file=sys.stderr)
-    result = driver.run(cfg)
+    from ..runtime import faults
+
+    try:
+        result = driver.run(cfg)
+    except faults.Preempted as e:
+        # Injected (or test-driven) preemption: in-flight progress was
+        # flushed before the raise; the same command resumes the run.
+        print(f"rdfind: preempted ({e}); re-run with the same "
+              f"--checkpoint-dir to resume from the last committed pass",
+              file=sys.stderr)
+        return 75  # EX_TEMPFAIL: transient, retry the same invocation
     if not (cfg.output_file or cfg.collect_result):
         print(f"Detected {len(result.table)} CINDs.")
     return 0

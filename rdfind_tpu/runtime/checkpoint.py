@@ -18,18 +18,24 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import sys
+import threading
+import weakref
 import zipfile
 
 import numpy as np
 
 from ..data import CindTable
 from ..dictionary import Dictionary
+from . import faults
 
 
 # Folded into every fingerprint; bump whenever a stage codec or any algorithm
 # upstream of a checkpointed artifact changes meaning, so stale checkpoints
 # from older code can never satisfy a newer run.
-CHECKPOINT_FORMAT = 1
+# 2: fault-domain hardening — durable (fsynced) saves, per-pass
+#    discover-progress stages, stats now carry degradation/retry telemetry.
+CHECKPOINT_FORMAT = 2
 
 
 def fingerprint(payload: dict) -> str:
@@ -40,10 +46,22 @@ def fingerprint(payload: dict) -> str:
 
 
 def input_signature(paths) -> list:
-    """Identity of the input files: path + size + mtime."""
+    """Identity of the input files: path + size + mtime.
+
+    A file that vanished between runs yields a [-1, -1] sentinel entry (the
+    fingerprint then differs from any run that saw the file — a clean
+    checkpoint miss with a diagnostic, never an unhandled traceback in the
+    resume path; the actual read phase reports the missing file properly).
+    """
     out = []
     for p in paths:
-        st = os.stat(p)
+        try:
+            st = os.stat(p)
+        except OSError as e:
+            print(f"note: checkpoint input {p} is not statable ({e}); "
+                  f"treating dependent checkpoints as stale", file=sys.stderr)
+            out.append([os.path.abspath(p), -1, -1])
+            continue
         out.append([os.path.abspath(p), st.st_size, int(st.st_mtime_ns)])
     return out
 
@@ -57,10 +75,33 @@ class CheckpointStore:
         return os.path.join(self.dir, f"{stage}.npz")
 
     def save(self, stage: str, fp: str, arrays: dict) -> None:
+        faults.maybe_fail("checkpoint_write")
         tmp = self._path(stage) + ".tmp.npz"  # .npz suffix: savez won't rename
         np.savez(tmp, __fingerprint__=np.frombuffer(fp.encode(), np.uint8),
                  **arrays)
+        # Durability before visibility: fsync the tmp file so a host crash
+        # between write and rename can never publish a truncated .npz under
+        # the final name, then fsync the directory so the rename itself
+        # survives the crash.  (A stale-but-complete old file is a fine
+        # outcome; a torn new one is not.)
+        with open(tmp, "rb") as f:
+            os.fsync(f.fileno())
         os.replace(tmp, self._path(stage))
+        try:
+            dfd = os.open(self.dir, os.O_RDONLY)
+        except OSError:
+            return  # e.g. a filesystem without directory fds; best effort
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    def discard(self, stage: str) -> None:
+        """Remove a stage file if present (superseded progress snapshots)."""
+        try:
+            os.remove(self._path(stage))
+        except OSError:
+            pass
 
     def load(self, stage: str, fp: str) -> dict | None:
         """The stage's arrays, or None if absent/stale/corrupt."""
@@ -73,7 +114,9 @@ class CheckpointStore:
                 if stored != fp:
                     return None
                 return {k: z[k] for k in z.files if k != "__fingerprint__"}
-        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+        except (OSError, ValueError, KeyError, EOFError, zipfile.BadZipFile):
+            # EOFError: np.load on a zero-length file (crash before any
+            # bytes landed) raises it instead of BadZipFile.
             return None
 
 
@@ -148,6 +191,133 @@ def encode_stats(stats: dict) -> dict:
         for i, col in enumerate(rules):
             out[f"__rules_{i}__"] = np.asarray(col)
     return out
+
+
+# --- Mid-discover progress (preemption-safe per-pass checkpoints) -----------
+
+# Every live ProgressStore, so signal handlers (runtime/driver.py) can flush
+# in-flight snapshots before the process dies.
+_PROGRESS_REGISTRY: "weakref.WeakSet[ProgressStore]" = weakref.WeakSet()
+
+
+def flush_all_progress() -> None:
+    """Synchronously drain every live ProgressStore's pending writes (called
+    from the driver's SIGTERM/SIGINT handlers)."""
+    for store in list(_PROGRESS_REGISTRY):
+        try:
+            store.flush()
+        except Exception:
+            pass  # a failed flush must never mask the signal itself
+
+
+def encode_progress(parts: dict) -> dict:
+    """{pass_idx: (host blocks, tail-counter tuple)} -> npz arrays."""
+    out = {"done": np.asarray(sorted(parts), np.int64)}
+    for p, (blocks, tele) in parts.items():
+        for i, b in enumerate(blocks):
+            out[f"p{p}_b{i}"] = np.asarray(b)
+        out[f"p{p}_tele"] = np.asarray(tele, np.int64)
+    return out
+
+
+def decode_progress(arrays: dict) -> dict:
+    out = {}
+    for p in arrays.get("done", np.zeros(0, np.int64)):
+        p = int(p)
+        blocks = []
+        while f"p{p}_b{len(blocks)}" in arrays:
+            blocks.append(arrays[f"p{p}_b{len(blocks)}"])
+        out[p] = (blocks, tuple(int(x) for x in arrays[f"p{p}_tele"]))
+    return out
+
+
+def _phase_slug(phase_key: str, seq: int) -> str:
+    safe = "".join(c if c.isalnum() or c in "-_" else "-" for c in phase_key)
+    return f"progress-{seq:03d}-{safe[:40]}"
+
+
+class ProgressStore:
+    """Preemption-safe per-pass discover checkpoints, written asynchronously.
+
+    The pass executor (models/sharded._Pipeline._run_passes) submits a
+    snapshot of every committed pass's host blocks after each pass; a worker
+    thread writes it through CheckpointStore.save (atomic + fsynced) OFF the
+    critical path, so a clean pass pays only the cost of handing over numpy
+    references.  A preempted run's successor loads the snapshot and replays
+    only unfinished passes (differentially bit-identical to an uninterrupted
+    run — tests/test_faults.py).
+
+    Fingerprints embed the base discover fingerprint plus the phase identity,
+    n_pass, mesh size and the planned capacities — everything that shapes how
+    passes partition the work.  Grown (retry) capacities are deliberately NOT
+    fingerprinted: a clean pass's output is capacity-independent.
+    """
+
+    def __init__(self, store: CheckpointStore, base_fp: str):
+        self.store = store
+        self.base_fp = base_fp
+        self._lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._stages: set[str] = set()
+        self._version = 0          # submission order (main thread only)
+        self._written: dict = {}   # stage -> newest version on disk
+        _PROGRESS_REGISTRY.add(self)
+
+    def phase_fp(self, phase_key: str, seq: int, *, n_pass: int, num_dev: int,
+                 extra=None) -> tuple[str, str]:
+        """(stage_name, fingerprint) of one pass-executor phase."""
+        fp = fingerprint(dict(base=self.base_fp, phase=phase_key, seq=seq,
+                              n_pass=n_pass, num_dev=num_dev, extra=extra))
+        return _phase_slug(phase_key, seq), fp
+
+    def load(self, stage: str, fp: str) -> dict | None:
+        arrays = self.store.load(stage, fp)
+        if arrays is None:
+            return None
+        return decode_progress(arrays)
+
+    def submit(self, stage: str, fp: str, parts: dict) -> None:
+        """Write a snapshot asynchronously.  Snapshots are cumulative and
+        versioned in submission order: a worker that lost the lock race to a
+        newer snapshot skips its write, so an older (smaller) snapshot can
+        never overwrite a newer one on disk."""
+        arrays = encode_progress(parts)
+        self._stages.add(stage)
+        self._version += 1
+        version = self._version
+
+        def write():
+            with self._lock:  # serialize writers; each write is atomic anyway
+                if self._written.get(stage, 0) > version:
+                    return  # a newer snapshot already landed
+                try:
+                    self.store.save(stage, fp, arrays)
+                    self._written[stage] = version
+                except Exception as e:
+                    # A failed progress write (incl. an injected
+                    # checkpoint_write fault) only coarsens resume
+                    # granularity; it must never fail the run.
+                    print(f"warning: progress checkpoint {stage} failed "
+                          f"({e}); resume granularity degrades, results do "
+                          f"not", file=sys.stderr)
+
+        t = threading.Thread(target=write, name=f"ckpt-{stage}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def flush(self) -> None:
+        """Block until every submitted snapshot has landed on disk."""
+        threads, self._threads = self._threads, []
+        for t in threads:
+            t.join()
+
+    def cleanup(self) -> None:
+        """Drop all progress stages (the full discover stage supersedes
+        them); called by the driver after the discover checkpoint is saved."""
+        self.flush()
+        for stage in self._stages:
+            self.store.discard(stage)
+        self._stages.clear()
 
 
 def decode_stats(arrays: dict) -> dict:

@@ -80,14 +80,28 @@ class DispatchStats:
     """
 
     __slots__ = ("n_host_syncs", "host_sync_ms", "pull_overlap_ms",
-                 "max_in_flight", "n_cap_retries")
+                 "max_in_flight", "n_cap_retries", "_pull_base",
+                 "_pull_absolute")
 
-    def __init__(self):
+    def __init__(self, pull_base: dict | None = None):
+        from . import faults
+
         self.n_host_syncs = 0
         self.host_sync_ms = 0.0
         self.pull_overlap_ms = 0.0
         self.max_in_flight = 0
         self.n_cap_retries = 0
+        # Baseline of the module-wide pull-retry counters (faults.guarded_pull
+        # wraps every mesh.host_gather*): publish() reports the delta since
+        # this baseline, extending the n_pair_cap_retries telemetry precedent.
+        # Callers whose pulls start before the executor (the sharded pipeline
+        # plans + builds lines first) pass their own earlier baseline; those
+        # publishes OVERWRITE the stats keys with the cumulative-since-base
+        # value instead of accumulating, so repeated publishes (one per S2L
+        # level) stay monotone without double counting.
+        self._pull_absolute = pull_base is not None
+        self._pull_base = pull_base if pull_base is not None \
+            else faults.pull_stats()
 
     def saw_in_flight(self, n: int) -> None:
         self.max_in_flight = max(self.max_in_flight, n)
@@ -121,3 +135,21 @@ class DispatchStats:
             stats.get("n_passes_in_flight", 0), self.max_in_flight)
         stats["n_pair_cap_retries"] = (
             stats.get("n_pair_cap_retries", 0) + self.n_cap_retries)
+        from . import faults
+
+        pulls = faults.pull_stats()
+        d_retries = (pulls["n_host_pull_retries"]
+                     - self._pull_base["n_host_pull_retries"])
+        d_backoff = (pulls["backoff_ms_total"]
+                     - self._pull_base["backoff_ms_total"])
+        if self._pull_absolute:
+            stats["n_host_pull_retries"] = d_retries
+            stats["backoff_ms_total"] = round(d_backoff, 3)
+        else:
+            stats["n_host_pull_retries"] = (
+                stats.get("n_host_pull_retries", 0) + d_retries)
+            stats["backoff_ms_total"] = round(
+                stats.get("backoff_ms_total", 0.0) + d_backoff, 3)
+            # The delta is consumed; re-baseline so a second publish (the
+            # S2L lattice publishes once per level) never double-counts.
+            self._pull_base = pulls

@@ -9,8 +9,12 @@ jitted device pipelines.  Per-phase wall-clock is recorded like JobMeasurement
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import os
+import signal
 import sys
+import threading
 import time
 
 import numpy as np
@@ -373,7 +377,7 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
                 v = f(v)
             return v
 
-    ckpt = discover_fp = None
+    ckpt = discover_fp = progress = None
     ingest_fp = ""
     if cfg.checkpoint_dir:
         import jax
@@ -397,6 +401,7 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
         ingest_fp = checkpoint.fingerprint({**cache_payload, **sharded_extra})
         discover_fp = checkpoint.fingerprint({**discover_payload,
                                               **sharded_extra})
+        progress = checkpoint.ProgressStore(ckpt, discover_fp)
 
     def ingest():
         hit: list = []
@@ -515,12 +520,14 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
             use_fis=cfg.use_frequent_item_set,
             use_ars=cfg.use_association_rules,
             clean_implied=cfg.clean_implied, stats=stats,
-            preshard=(g_triples, g_valid)))
+            progress=progress, preshard=(g_triples, g_valid)))
         if ckpt is not None:
             def save_discover():
                 arrays = checkpoint.encode_cinds(table)
                 arrays.update(checkpoint.encode_stats(stats))
-                ckpt.save(discover_stage, discover_fp, arrays)
+                _safe_save(ckpt, discover_stage, discover_fp, arrays,
+                           counters)
+                progress.cleanup()  # per-pass snapshots are now superseded
             phases.run("checkpoint-discover", save_discover)
     counters["cind-counter"] = len(table)
     if (cfg.ar_output_file and cfg.use_frequent_item_set
@@ -547,7 +554,62 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
     return RunResult(table, dictionary, None, counters, phases.timings)
 
 
+@contextlib.contextmanager
+def _flush_progress_on_signal(enabled: bool):
+    """SIGTERM/SIGINT (the preemption notice on TPU VMs) flush every live
+    mid-discover ProgressStore before the process dies, so the successor run
+    resumes from the last committed pass instead of the last stage boundary.
+
+    Installed only on the main thread of checkpointed runs; the previous
+    handlers are restored on exit and re-invoked after the flush.
+    """
+    if (not enabled
+            or threading.current_thread() is not threading.main_thread()):
+        yield
+        return
+    installed = {}
+
+    def handler(signum, frame):
+        checkpoint.flush_all_progress()
+        signal.signal(signum, installed[signum])
+        if signum == signal.SIGINT:
+            raise KeyboardInterrupt
+        os.kill(os.getpid(), signum)  # re-deliver to the restored handler
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            installed[sig] = signal.signal(sig, handler)
+        except (ValueError, OSError):  # exotic embedding; best effort
+            pass
+    try:
+        yield
+    finally:
+        for sig, prev in installed.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+
+
+def _safe_save(ckpt: "checkpoint.CheckpointStore", stage: str, fp: str,
+               arrays: dict, counters: dict) -> None:
+    """A failed checkpoint write must never fail an otherwise-complete run —
+    it only costs the NEXT run its resume (counted + warned, never raised)."""
+    try:
+        ckpt.save(stage, fp, arrays)
+    except Exception as e:
+        counters["checkpoint-errors"] = counters.get("checkpoint-errors",
+                                                     0) + 1
+        print(f"warning: checkpoint stage {stage} not written ({e}); "
+              f"the next run will recompute it", file=sys.stderr)
+
+
 def run(cfg: Config) -> RunResult:
+    with _flush_progress_on_signal(bool(cfg.checkpoint_dir)):
+        return _run_profiled(cfg)
+
+
+def _run_profiled(cfg: Config) -> RunResult:
     if cfg.profile_dir:
         # Device-level observability the reference cannot offer (its tracing
         # stops at per-plan wall clocks, AbstractFlinkProgram.java:65-77):
@@ -578,10 +640,13 @@ def _run(cfg: Config) -> RunResult:
                   and not cfg.only_read
                   and reader.is_utf8(cfg.encoding))  # native parser is UTF-8-only
 
-    ckpt = ingest_fp = discover_fp = None
+    ckpt = ingest_fp = discover_fp = progress = None
     if cfg.checkpoint_dir and not cfg.only_read:
         ckpt = checkpoint.CheckpointStore(cfg.checkpoint_dir)
         ingest_fp, discover_fp = _checkpoint_fps(cfg, use_native)
+        # Mid-discover per-pass checkpoints (sharded runs): a preempted
+        # discover resumes from its last committed pass, not from ingest.
+        progress = checkpoint.ProgressStore(ckpt, discover_fp)
 
     ids = dictionary = None
     if ckpt is not None:
@@ -622,7 +687,7 @@ def _run(cfg: Config) -> RunResult:
                 if "distinct-triples" in counters:
                     arrays["distinct_triples"] = np.int64(
                         counters["distinct-triples"])
-                ckpt.save("ingest", ingest_fp, arrays)
+                _safe_save(ckpt, "ingest", ingest_fp, arrays, counters)
             phases.run("checkpoint-ingest", save_ingest)
     counters["distinct-values"] = len(dictionary)
 
@@ -703,26 +768,26 @@ def _run(cfg: Config) -> RunResult:
             if strategy == 2:
                 return sharded.discover_sharded_approx(
                     ids, cfg.min_support, mesh=mesh, skew=skew, combine=cfg.combinable_join,
-                    projections=cfg.projections,
+                    progress=progress, projections=cfg.projections,
                     use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
                     clean_implied=cfg.clean_implied, stats=stats)
             if strategy == 3:
                 return sharded.discover_sharded_late_bb(
                     ids, cfg.min_support, mesh=mesh, skew=skew, combine=cfg.combinable_join,
-                    projections=cfg.projections,
+                    progress=progress, projections=cfg.projections,
                     use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
                     clean_implied=cfg.clean_implied, stats=stats)
             if strategy == 1:
                 return sharded.discover_sharded_s2l(
                     ids, cfg.min_support, mesh=mesh, skew=skew, combine=cfg.combinable_join,
-                    projections=cfg.projections,
+                    progress=progress, projections=cfg.projections,
                     use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
                     clean_implied=cfg.clean_implied, stats=stats)
             if strategy != 0:
                 raise ValueError(f"unknown traversal strategy {strategy}")
             return sharded.discover_sharded(
                 ids, cfg.min_support, mesh=mesh, skew=skew, combine=cfg.combinable_join,
-                projections=cfg.projections,
+                progress=progress, projections=cfg.projections,
                 use_fis=cfg.use_frequent_item_set, use_ars=use_ars,
                 clean_implied=cfg.clean_implied, stats=stats)
         try:
@@ -781,7 +846,8 @@ def _run(cfg: Config) -> RunResult:
             def save_discover():
                 arrays = checkpoint.encode_cinds(table)
                 arrays.update(checkpoint.encode_stats(stats))
-                ckpt.save("discover", discover_fp, arrays)
+                _safe_save(ckpt, "discover", discover_fp, arrays, counters)
+                progress.cleanup()  # per-pass snapshots are now superseded
             phases.run("checkpoint-discover", save_discover)
     counters["cind-counter"] = len(table)
     counters.update({f"stat-{k}": v for k, v in stats.items()})
@@ -826,6 +892,23 @@ def _emit_sinks(cfg: Config, phases: _Phases, counters: dict, table,
               f"overlap_ms={stats.get('pull_overlap_ms', 0.0):.1f} "
               f"cap_retries={stats.get('n_pair_cap_retries', 0)} "
               f"cap_p={stats.get('cap_p_final', 0)}", file=sys.stderr)
+
+    if cfg.debug_level >= 1 and stats.get("degradations") and _is_primary():
+        # The degradation ledger: every ladder rung the run took instead of
+        # dying (grow / split / skip / fallback), in order.
+        for step in stats["degradations"]:
+            print(f"degradation: {step}", file=sys.stderr)
+        print(f"ladder rungs: {stats.get('ladder_rung', {})}",
+              file=sys.stderr)
+    if cfg.debug_level >= 1 and _is_primary() and (
+            stats.get("n_overflow_retries") or stats.get("n_host_pull_retries")
+            or stats.get("resumed_passes")):
+        print(f"fault recovery: overflow_retries="
+              f"{stats.get('n_overflow_retries', 0)} "
+              f"host_pull_retries={stats.get('n_host_pull_retries', 0)} "
+              f"backoff_ms={stats.get('backoff_ms_total', 0.0):.1f} "
+              f"resumed_passes={stats.get('resumed_passes', 0)}",
+              file=sys.stderr)
 
     if cfg.debug_level >= 2 and len(table):
         # DEBUG_LEVEL_SANITY: trivial CINDs in the output indicate a pipeline

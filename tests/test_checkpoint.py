@@ -1,4 +1,5 @@
-"""Stage-boundary checkpoint/resume through the driver."""
+"""Stage-boundary checkpoint/resume through the driver, durability of the
+save path (fsync + atomic rename), and the mid-discover progress codec."""
 
 import os
 import time
@@ -8,7 +9,7 @@ import pytest
 
 from rdfind_tpu.data import CindTable
 from rdfind_tpu.dictionary import Dictionary
-from rdfind_tpu.runtime import checkpoint, driver
+from rdfind_tpu.runtime import checkpoint, driver, faults
 
 NT = """\
 <http://x/s1> <http://x/p1> "v1" .
@@ -105,6 +106,95 @@ def test_stats_survive_resume(fixture_nt, tmp_path):
     assert second.counters["resumed-discover"] == 1
     for k, v in first_stats.items():
         assert second.counters.get(k) == v, k
+
+
+def test_truncated_checkpoint_is_clean_miss(tmp_path):
+    """A zero-length or torn .npz (host crash mid-write before the fsync
+    hardening, partial copy, disk-full) must read as a miss, never crash."""
+    store = checkpoint.CheckpointStore(str(tmp_path))
+    fp = checkpoint.fingerprint({"x": 1})
+    store.save("stage", fp, {"a": np.arange(1000)})
+    assert store.load("stage", fp) is not None
+
+    path = tmp_path / "stage.npz"
+    raw = path.read_bytes()
+    path.write_bytes(b"")  # zero-length file
+    assert store.load("stage", fp) is None
+    path.write_bytes(raw[: len(raw) // 2])  # torn tail
+    assert store.load("stage", fp) is None
+    path.write_bytes(raw)  # intact bytes still load
+    assert store.load("stage", fp) is not None
+
+
+def test_save_leaves_no_tmp_file(tmp_path):
+    store = checkpoint.CheckpointStore(str(tmp_path))
+    store.save("stage", "fp", {"a": np.arange(4)})
+    assert sorted(os.listdir(tmp_path)) == ["stage.npz"]
+    store.discard("stage")
+    assert os.listdir(tmp_path) == []
+    store.discard("stage")  # idempotent
+
+
+def test_input_signature_missing_file_is_diagnosed(tmp_path, capsys):
+    f = tmp_path / "gone.nt"
+    f.write_text("x")
+    sig_present = checkpoint.input_signature([str(f)])
+    f.unlink()
+    sig_missing = checkpoint.input_signature([str(f)])  # must not raise
+    assert sig_missing[0][1:] == [-1, -1]
+    assert sig_present != sig_missing  # dependent checkpoints go stale
+    assert "not statable" in capsys.readouterr().err
+
+
+def test_progress_codec_roundtrip():
+    parts = {
+        0: ([np.arange(3, dtype=np.int64), np.ones(2, np.int32)], (1, 2, 3)),
+        2: ([np.zeros(0, np.int64), np.arange(4, dtype=np.int32)], (4, 5, 6)),
+    }
+    out = checkpoint.decode_progress(checkpoint.encode_progress(parts))
+    assert sorted(out) == [0, 2]
+    for p in parts:
+        got_blocks, got_tele = out[p]
+        want_blocks, want_tele = parts[p]
+        assert got_tele == want_tele
+        assert len(got_blocks) == len(want_blocks)
+        for g, w in zip(got_blocks, want_blocks):
+            np.testing.assert_array_equal(g, w)
+
+
+def test_progress_store_roundtrip_and_cleanup(tmp_path):
+    store = checkpoint.ProgressStore(
+        checkpoint.CheckpointStore(str(tmp_path)), "base")
+    stage, fp = store.phase_fp("cind", 0, n_pass=3, num_dev=8)
+    parts = {0: ([np.arange(5)], (7, 8, 9))}
+    store.submit(stage, fp, parts)
+    store.flush()
+    assert store.load(stage, fp) is not None
+    # A different n_pass fingerprints differently: stale snapshots miss.
+    stage2, fp2 = store.phase_fp("cind", 0, n_pass=6, num_dev=8)
+    assert stage2 == stage and fp2 != fp
+    assert store.load(stage2, fp2) is None
+    store.cleanup()
+    assert store.load(stage, fp) is None
+
+
+def test_checkpoint_write_failure_degrades(fixture_nt, tmp_path, monkeypatch):
+    """An injected checkpoint-write fault must not fail the run — it only
+    costs the NEXT run its resume (counted in checkpoint-errors)."""
+    cfg = make_cfg(fixture_nt, tmp_path)
+    monkeypatch.setenv("RDFIND_FAULTS", "checkpoint_write:times=-1")
+    faults.reset()
+    try:
+        res = driver.run(cfg)
+    finally:
+        monkeypatch.delenv("RDFIND_FAULTS")
+        faults.reset()
+    assert res.counters["checkpoint-errors"] >= 1
+    assert len(res.table) > 0
+    # Nothing durable was written, so the next (fault-free) run re-ingests.
+    res2 = driver.run(cfg)
+    assert "resumed-ingest" not in res2.counters
+    assert res2.table.to_rows() == res.table.to_rows()
 
 
 def test_format_version_in_fingerprint(monkeypatch):
