@@ -8,40 +8,97 @@
 // (here exact interning, see rdfind_tpu/dictionary.py) — fused into one pass so
 // triple ids land directly in an int32 buffer ready for the device pipeline.
 //
-// Semantics parity with the Python path (rdfind_tpu/io/ntriples.py,
-// rdfind_tpu/dictionary.py):
+// Two execution modes share one handle type:
+//
+//   * the SERIAL path (rdf_ingest_file + rdf_ingest_finalize): one thread,
+//     one interner, byte-sort + remap at the end.  This is the reference
+//     implementation of the id contract below and stays deliberately simple.
+//   * the PARALLEL STREAMING path (rdf_ingest_begin / rdf_ingest_next_block /
+//     rdf_ingest_stream_finish): a work-stealing unit queue (whole files, or
+//     newline-bounded byte ranges of large PLAIN files — gz members are not
+//     seekable, so .gz splits at file granularity only, exactly like the
+//     reference where gz is unsplittable, MultiFileTextInputFormat.java:
+//     225-230) feeding N worker threads, each with its own arena-backed
+//     interner emitting provisional thread-local ids.  Committed unit blocks
+//     stream to the caller IN UNIT ORDER while later units still parse; the
+//     finish step hash-partitions the per-thread interners into S shards
+//     (crc32 % S — the SAME partition function as the multi-host dictionary,
+//     rdfind_tpu/dictionary.py:value_shard), dedupes each shard in parallel,
+//     S-way-merges the shard-sorted runs into the byte-sorted global rank
+//     order, and exports per-thread local→global remap tables for the caller
+//     to rewrite its streamed blocks.
+//
+// The id contract (BOTH paths, bit-identical by construction):
 //   * terms keep surface syntax (<iri>, _:blank, "lit"@lang, "lit"^^<t>);
 //   * ids are ranks in byte-sorted order of the distinct values, which equals
 //     np.unique's code-point order for valid UTF-8;
+//   * triples keep input order (file order, then line order; a split plain
+//     file's chunks are delivered in offset order);
 //   * universal newlines (\n, \r\n, \r), '#' comment lines skipped;
 //   * .gz inputs transparently decompressed (zlib gzopen also passes through
 //     plain files, so one read path serves both).
+//
+// Chunk ownership rule (Hadoop-style line splits): a chunk [o, e) with o > 0
+// first discards bytes through the first line terminator at/after o, then
+// parses every line whose first byte starts at position <= e (reading past e
+// to finish its last line).  A line starting exactly at e belongs to the
+// chunk ENDING at e; the next chunk's unconditional discard drops it.  Every
+// line is therefore parsed exactly once, for any chunking.
 
 #include <zlib.h>
 
 #include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <deque>
+#include <memory>
+#include <mutex>
 #include <numeric>
 #include <string>
+#include <string_view>
+#include <sys/stat.h>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
 namespace {
 
-struct Ingest {
-  // Arena-backed interner: string bytes live in stable deque chunks so the
-  // string_view keys stay valid while the map grows.
+using Clock = std::chrono::steady_clock;
+
+int64_t ns_since(Clock::time_point t0) {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(Clock::now() -
+                                                              t0)
+      .count();
+}
+
+// Per-phase ingest telemetry (exported via rdf_ingest_stats).  Worker-side
+// counters are atomics (summed across threads); merge-stage counters are
+// written single-threaded after the join.
+struct Stats {
+  std::atomic<int64_t> bytes_read{0};  // post-decompression bytes parsed
+  std::atomic<int64_t> read_ns{0};     // time inside gzread/fread calls
+  std::atomic<int64_t> parse_ns{0};    // tokenize+intern (unit wall - read)
+  int64_t intern_ns = 0;               // shard dedupe+sort (dictionary build)
+  int64_t merge_ns = 0;                // partition + global rank merge
+  int64_t remap_ns = 0;                // local->global table construction
+  std::atomic<int64_t> queue_stalls{0};  // next_block waits that blocked
+  std::atomic<int64_t> stall_ns{0};      // total blocked time in next_block
+  int64_t n_units = 0;
+  int64_t n_files = 0;
+  int n_threads = 1;
+};
+
+// Arena-backed interner: string bytes live in stable deque chunks so the
+// string_view keys stay valid while the map grows.  One per handle on the
+// serial path; one per worker thread on the parallel path.
+struct Interner {
   std::deque<std::string> arena;
   std::unordered_map<std::string_view, int32_t> intern;
   std::vector<const std::string*> by_id;  // provisional id -> string
-  std::vector<int32_t> triples;           // flat (n, 3)
-  std::vector<int32_t> remap;             // provisional id -> sorted rank
-  std::vector<int64_t> sorted_offsets;    // finalize(): prefix offsets
-  int64_t values_bytes = 0;
-  std::string error;
-  bool finalized = false;
 
   int32_t intern_token(const char* s, size_t len) {
     std::string_view key(s, len);
@@ -53,6 +110,31 @@ struct Ingest {
     intern.emplace(std::string_view(arena.back()), id);
     return id;
   }
+};
+
+// Everything one parsed line needs: where ids come from, where triples go,
+// where errors land.  Serial parsing points at the handle's members; each
+// parallel worker points at its own shard + the unit's triple buffer.
+struct ParseCtx {
+  Interner* in;
+  std::vector<int32_t>* triples;
+  std::string* error;
+};
+
+struct Parallel;  // fwd
+
+struct Ingest {
+  Interner dict;                  // serial-path interner
+  std::vector<int32_t> triples;   // serial path: flat (n, 3)
+  std::vector<int32_t> remap;     // serial path: provisional id -> rank
+  // Export representation shared by both paths after finalize/stream_finish:
+  std::vector<std::string_view> sorted_vals;  // byte-sorted distinct values
+  std::vector<int64_t> sorted_offsets;        // prefix offsets
+  int64_t values_bytes = 0;
+  std::string error;
+  bool finalized = false;
+  Stats stats;
+  std::unique_ptr<Parallel> par;  // non-null once rdf_ingest_begin ran
 };
 
 // --- Tokenizer (mirrors ntriples._scan_term) -------------------------------
@@ -120,7 +202,7 @@ size_t scan_term(const char* line, size_t i, size_t n, Term* out,
 
 // Parses one line into interned (s, p, o); returns 1 on triple, 0 on blank
 // line, -1 on error.
-int parse_line(Ingest* ing, const char* line, size_t n, bool tabs,
+int parse_line(ParseCtx* ctx, const char* line, size_t n, bool tabs,
                bool expect_quad) {
   if (tabs) {
     // split("\t"), need >= 3 fields (parse_tab_line).
@@ -140,15 +222,15 @@ int parse_line(Ingest* ing, const char* line, size_t n, bool tabs,
       const char* tab =
           static_cast<const char*>(memchr(field, '\t', end - field));
       const char* fe = tab ? tab : end;
-      ids[got++] = ing->intern_token(field, fe - field);
+      ids[got++] = ctx->in->intern_token(field, fe - field);
       if (!tab) break;
       field = tab + 1;
     }
     if (got < 3) {
-      ing->error = "expected 3 tab-separated fields";
+      *ctx->error = "expected 3 tab-separated fields";
       return -1;
     }
-    ing->triples.insert(ing->triples.end(), ids, ids + 3);
+    ctx->triples->insert(ctx->triples->end(), ids, ids + 3);
     return 1;
   }
   size_t i = 0;
@@ -159,67 +241,42 @@ int parse_line(Ingest* ing, const char* line, size_t n, bool tabs,
     while (i < n && is_ws(line[i])) i++;
     if (i >= n || line[i] == '.') break;
     Term t;
-    i = scan_term(line, i, n, &t, &ing->error);
+    i = scan_term(line, i, n, &t, ctx->error);
     if (i == static_cast<size_t>(-1)) return -1;
-    if (got < 3) ids[got] = ing->intern_token(t.p, t.len);
+    if (got < 3) ids[got] = ctx->in->intern_token(t.p, t.len);
     got++;
   }
   if (got == 0) return 0;
   if (got < 3) {
-    ing->error = "expected 3 terms, got " + std::to_string(got);
+    *ctx->error = "expected 3 terms, got " + std::to_string(got);
     return -1;
   }
-  ing->triples.insert(ing->triples.end(), ids, ids + 3);
+  ctx->triples->insert(ctx->triples->end(), ids, ids + 3);
   return 1;
 }
 
-}  // namespace
+// --- Line streaming --------------------------------------------------------
 
-extern "C" {
-
-Ingest* rdf_ingest_new() { return new Ingest(); }
-
-void rdf_ingest_free(Ingest* ing) { delete ing; }
-
-const char* rdf_ingest_error(Ingest* ing) { return ing->error.c_str(); }
-
-// Reads and parses one file; returns triples parsed from it, or -1 on error.
-int64_t rdf_ingest_file(Ingest* ing, const char* path, int tabs,
-                        int expect_quad, int skip_comments) {
-  if (ing->finalized) {
-    ing->error = "ingest already finalized";
-    return -1;
-  }
-  gzFile f = gzopen(path, "rb");
-  if (!f) {
-    ing->error = std::string("cannot open ") + path;
-    return -1;
-  }
-  gzbuffer(f, 1 << 20);
+// Streams universal-newline lines from an opened gz file (plain files pass
+// through) into handle(line, len) -> bool.  Returns false on read error or
+// handle failure (err set).  read_ns/bytes accumulate I/O telemetry.
+template <typename H>
+bool for_gz_lines(gzFile f, const char* path, std::string* err, H&& handle,
+                  int64_t* read_ns, int64_t* bytes_read) {
   std::vector<char> buf(1 << 20);
   std::string carry;  // partial line across read chunks
-  int64_t count = 0;
-  auto handle = [&](const char* line, size_t len) -> bool {
-    if (skip_comments && len > 0 && line[0] == '#') return true;
-    int rc = parse_line(ing, line, len, tabs != 0, expect_quad != 0);
-    if (rc < 0) {
-      ing->error += std::string(" in ") + path;
-      return false;
-    }
-    count += rc;
-    return true;
-  };
   bool ok = true;
   while (ok) {
+    auto t0 = Clock::now();
     int nread = gzread(f, buf.data(), static_cast<unsigned>(buf.size()));
+    *read_ns += ns_since(t0);
     if (nread < 0) {
       int errnum = 0;
-      ing->error = std::string("read error in ") + path + ": " +
-                   gzerror(f, &errnum);
-      ok = false;
-      break;
+      *err = std::string("read error in ") + path + ": " + gzerror(f, &errnum);
+      return false;
     }
     if (nread == 0) break;
+    *bytes_read += nread;
     const char* p = buf.data();
     const char* end = p + nread;
     while (p < end) {
@@ -244,7 +301,301 @@ int64_t rdf_ingest_file(Ingest* ing, const char* path, int tabs,
     }
   }
   if (ok && !carry.empty()) ok = handle(carry.data(), carry.size());
+  return ok;
+}
+
+// Streams the lines OWNED by byte range [off, off+len) of a plain file (see
+// the chunk ownership rule in the header comment) into handle().
+template <typename H>
+bool for_chunk_lines(const char* path, int64_t off, int64_t len,
+                     std::string* err, H&& handle, int64_t* read_ns,
+                     int64_t* bytes_read) {
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    *err = std::string("cannot open ") + path;
+    return false;
+  }
+  if (off > 0 && fseek(f, static_cast<long>(off), SEEK_SET) != 0) {
+    *err = std::string("cannot seek in ") + path;
+    fclose(f);
+    return false;
+  }
+  const int64_t end = off + len;  // lines starting at pos <= end are ours
+  std::vector<char> buf(1 << 20);
+  std::string carry;
+  bool discard = off > 0;  // drop through the first terminator (prev owns it)
+  bool pending_cr = false;  // '\r' consumed at buffer end; eat a leading '\n'
+  int64_t pos = off;        // absolute offset of the next unread byte
+  int64_t line_start = off;
+  bool ok = true;
+  bool done = false;
+  while (ok && !done) {
+    // Read the owned range in full-buffer strides, then finish the final
+    // line in small tail reads — the overshoot past `end` stays bounded by
+    // one tail stride instead of a whole buffer.
+    size_t want = buf.size();
+    if (pos <= end)
+      want = static_cast<size_t>(
+          std::min<int64_t>(static_cast<int64_t>(want), end - pos + 1));
+    else
+      want = 4096;
+    auto t0 = Clock::now();
+    size_t nread = fread(buf.data(), 1, want, f);
+    *read_ns += ns_since(t0);
+    if (nread == 0) break;  // EOF (or error: tail handled below)
+    *bytes_read += static_cast<int64_t>(nread);
+    const char* p = buf.data();
+    const char* bend = p + nread;
+    if (pending_cr) {
+      pending_cr = false;
+      if (*p == '\n') {
+        p++;
+        pos++;
+        line_start = pos;
+        if (line_start > end) {
+          done = true;
+          break;
+        }
+      }
+    }
+    while (p < bend) {
+      const char* nl = p;
+      while (nl < bend && *nl != '\n' && *nl != '\r') nl++;
+      if (nl == bend) {
+        if (!discard) carry.append(p, bend - p);
+        pos += bend - p;
+        break;
+      }
+      if (discard) {
+        discard = false;
+      } else if (!carry.empty()) {
+        carry.append(p, nl - p);
+        ok = handle(carry.data(), carry.size());
+        carry.clear();
+      } else {
+        ok = handle(p, nl - p);
+      }
+      if (!ok) break;
+      int64_t term = 1;
+      if (*nl == '\r') {
+        if (nl + 1 < bend) {
+          if (nl[1] == '\n') term = 2;
+        } else {
+          pending_cr = true;  // resolve against the next refill
+        }
+      }
+      pos += (nl - p) + term;
+      p = nl + term;
+      line_start = pos;
+      if (line_start > end) {
+        done = true;
+        break;
+      }
+    }
+  }
+  if (ok && !done && !discard && !carry.empty() && line_start <= end)
+    ok = handle(carry.data(), carry.size());  // final unterminated line
+  fclose(f);
+  return ok;
+}
+
+// --- Parallel streaming engine ---------------------------------------------
+
+struct Unit {
+  std::string path;
+  int64_t off = 0;    // byte range (plain-file chunks); whole=-range unused
+  int64_t len = 0;
+  bool whole = true;  // read via gzopen (gz files and unsplit plain files)
+};
+
+struct UnitResult {
+  std::vector<int32_t> triples;  // provisional thread-local ids
+  int thread = -1;
+  std::string error;
+  bool skipped = false;  // queued after a failed unit; never delivered
+};
+
+struct ThreadShard {
+  Interner in;
+  std::vector<int32_t> to_global;  // local id -> byte-sorted global rank
+  // Per-merge-shard local-id buckets (filled by the partition stage, read by
+  // the dedupe and remap stages).
+  std::vector<std::vector<int32_t>> buckets;
+};
+
+struct Parallel {
+  std::vector<Unit> units;
+  std::vector<UnitResult> results;
+  std::vector<std::unique_ptr<ThreadShard>> shards;  // one per worker thread
+  std::vector<std::thread> workers;
+  std::atomic<size_t> next_unit{0};
+  // First failed unit index: workers skip units queued after it (best-effort
+  // cancellation; earlier units still complete so in-order delivery reaches
+  // the failure deterministically — the same "first error wins" surface as
+  // the serial path).
+  std::atomic<int64_t> abort_after{INT64_MAX};
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<char> done;  // guarded by mu
+  size_t next_deliver = 0;
+  int64_t cur_block = -1;
+  bool tabs = false, quad = false, skip_comments = true;
+  bool joined = false;
+  bool drained = false;
+
+  void join_workers() {
+    if (joined) return;
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    joined = true;
+  }
+  ~Parallel() { join_workers(); }
+};
+
+void process_unit(const Unit& u, UnitResult* res, ThreadShard* sh,
+                  const Parallel& p, Stats* stats) {
+  std::string err;
+  ParseCtx ctx{&sh->in, &res->triples, &err};
+  auto handle = [&](const char* line, size_t len) -> bool {
+    if (p.skip_comments && len > 0 && line[0] == '#') return true;
+    int rc = parse_line(&ctx, line, len, p.tabs, p.quad);
+    if (rc < 0) {
+      err += std::string(" in ") + u.path;
+      return false;
+    }
+    return true;
+  };
+  int64_t read_ns = 0, bytes = 0;
+  auto t0 = Clock::now();
+  bool ok;
+  if (u.whole) {
+    gzFile f = gzopen(u.path.c_str(), "rb");
+    if (!f) {
+      res->error = std::string("cannot open ") + u.path;
+      return;
+    }
+    gzbuffer(f, 1 << 20);
+    ok = for_gz_lines(f, u.path.c_str(), &err, handle, &read_ns, &bytes);
+    gzclose(f);
+  } else {
+    ok = for_chunk_lines(u.path.c_str(), u.off, u.len, &err, handle, &read_ns,
+                         &bytes);
+  }
+  int64_t wall = ns_since(t0);
+  stats->read_ns += read_ns;
+  stats->parse_ns += wall - read_ns;
+  stats->bytes_read += bytes;
+  if (!ok) res->error = err;
+}
+
+void worker_main(Parallel* p, int thread_idx, Stats* stats) {
+  ThreadShard* sh = p->shards[thread_idx].get();
+  while (true) {
+    size_t u = p->next_unit.fetch_add(1);
+    if (u >= p->units.size()) break;
+    UnitResult* res = &p->results[u];
+    res->thread = thread_idx;
+    if (static_cast<int64_t>(u) > p->abort_after.load()) {
+      res->skipped = true;  // after a failure; never delivered
+    } else {
+      process_unit(p->units[u], res, sh, *p, stats);
+      if (!res->error.empty()) {
+        int64_t cur = p->abort_after.load();
+        while (static_cast<int64_t>(u) < cur &&
+               !p->abort_after.compare_exchange_weak(cur,
+                                                     static_cast<int64_t>(u)))
+          ;
+      }
+    }
+    {
+      std::lock_guard<std::mutex> lk(p->mu);
+      p->done[u] = 1;
+    }
+    p->cv.notify_all();
+  }
+}
+
+// Runs fn(i) for i in [0, n) on up to `threads` std::threads (merge-stage
+// parallelism; workers have already joined by the time this runs).
+template <typename F>
+void parallel_for(int64_t n, int threads, F&& fn) {
+  if (n <= 0) return;
+  int use = static_cast<int>(
+      std::max<int64_t>(1, std::min<int64_t>(threads, n)));
+  if (use == 1) {
+    for (int64_t i = 0; i < n; i++) fn(i);
+    return;
+  }
+  std::atomic<int64_t> next{0};
+  std::vector<std::thread> pool;
+  for (int t = 0; t < use; t++)
+    pool.emplace_back([&] {
+      int64_t i;
+      while ((i = next.fetch_add(1)) < n) fn(i);
+    });
+  for (auto& t : pool) t.join();
+}
+
+int64_t file_size(const char* path) {
+  struct stat st;
+  if (stat(path, &st) != 0) return -1;
+  return static_cast<int64_t>(st.st_size);
+}
+
+bool ends_with_gz(const std::string& p) {
+  return p.size() >= 3 && p.compare(p.size() - 3, 3, ".gz") == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+Ingest* rdf_ingest_new() { return new Ingest(); }
+
+void rdf_ingest_free(Ingest* ing) { delete ing; }
+
+const char* rdf_ingest_error(Ingest* ing) { return ing->error.c_str(); }
+
+// --- Serial path (the reference implementation of the id contract) ---------
+
+// Reads and parses one file; returns triples parsed from it, or -1 on error.
+int64_t rdf_ingest_file(Ingest* ing, const char* path, int tabs,
+                        int expect_quad, int skip_comments) {
+  if (ing->finalized) {
+    ing->error = "ingest already finalized";
+    return -1;
+  }
+  if (ing->par) {
+    ing->error = "streaming ingest already begun; use the block API";
+    return -1;
+  }
+  gzFile f = gzopen(path, "rb");
+  if (!f) {
+    ing->error = std::string("cannot open ") + path;
+    return -1;
+  }
+  gzbuffer(f, 1 << 20);
+  int64_t count = 0;
+  ParseCtx ctx{&ing->dict, &ing->triples, &ing->error};
+  auto handle = [&](const char* line, size_t len) -> bool {
+    if (skip_comments && len > 0 && line[0] == '#') return true;
+    int rc = parse_line(&ctx, line, len, tabs != 0, expect_quad != 0);
+    if (rc < 0) {
+      ing->error += std::string(" in ") + path;
+      return false;
+    }
+    count += rc;
+    return true;
+  };
+  int64_t read_ns = 0, bytes = 0;
+  auto t0 = Clock::now();
+  bool ok = for_gz_lines(f, path, &ing->error, handle, &read_ns, &bytes);
   gzclose(f);
+  ing->stats.read_ns += read_ns;
+  ing->stats.parse_ns += ns_since(t0) - read_ns;
+  ing->stats.bytes_read += bytes;
+  ing->stats.n_files++;
+  ing->stats.n_units++;
   return ok ? count : -1;
 }
 
@@ -252,31 +603,35 @@ int64_t rdf_ingest_file(Ingest* ing, const char* path, int tabs,
 // Returns the number of distinct values.
 int64_t rdf_ingest_finalize(Ingest* ing) {
   if (!ing->finalized) {
-    size_t nvals = ing->by_id.size();
+    auto t0 = Clock::now();
+    size_t nvals = ing->dict.by_id.size();
     std::vector<int32_t> order(nvals);
     std::iota(order.begin(), order.end(), 0);
     std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
-      return *ing->by_id[a] < *ing->by_id[b];
+      return *ing->dict.by_id[a] < *ing->dict.by_id[b];
     });
     ing->remap.assign(nvals, 0);
     for (size_t rank = 0; rank < nvals; rank++)
       ing->remap[order[rank]] = static_cast<int32_t>(rank);
+    ing->stats.merge_ns += ns_since(t0);
+    t0 = Clock::now();
     for (auto& id : ing->triples) id = ing->remap[id];
-    // by_id in sorted order + offsets for export.
-    std::vector<const std::string*> sorted(nvals);
+    // sorted export views + offsets.
+    ing->sorted_vals.resize(nvals);
     ing->sorted_offsets.assign(nvals + 1, 0);
     int64_t off = 0;
     for (size_t rank = 0; rank < nvals; rank++) {
-      sorted[rank] = ing->by_id[order[rank]];
+      const std::string* s = ing->dict.by_id[order[rank]];
+      ing->sorted_vals[rank] = std::string_view(*s);
       ing->sorted_offsets[rank] = off;
-      off += static_cast<int64_t>(sorted[rank]->size());
+      off += static_cast<int64_t>(s->size());
     }
     ing->sorted_offsets[nvals] = off;
     ing->values_bytes = off;
-    ing->by_id.swap(sorted);
+    ing->stats.remap_ns += ns_since(t0);
     ing->finalized = true;
   }
-  return static_cast<int64_t>(ing->by_id.size());
+  return static_cast<int64_t>(ing->sorted_vals.size());
 }
 
 int64_t rdf_ingest_num_triples(Ingest* ing) {
@@ -293,12 +648,278 @@ int64_t rdf_ingest_values_bytes(Ingest* ing) { return ing->values_bytes; }
 // num_values + 1 prefix offsets into buf.
 void rdf_ingest_get_values(Ingest* ing, char* buf, int64_t* offsets) {
   if (!ing->finalized) return;
-  size_t nvals = ing->by_id.size();
+  size_t nvals = ing->sorted_vals.size();
   for (size_t i = 0; i < nvals; i++)
-    memcpy(buf + ing->sorted_offsets[i], ing->by_id[i]->data(),
-           ing->by_id[i]->size());
-  memcpy(offsets, ing->sorted_offsets.data(),
-         (nvals + 1) * sizeof(int64_t));
+    memcpy(buf + ing->sorted_offsets[i], ing->sorted_vals[i].data(),
+           ing->sorted_vals[i].size());
+  memcpy(offsets, ing->sorted_offsets.data(), (nvals + 1) * sizeof(int64_t));
+}
+
+// --- Parallel streaming path -----------------------------------------------
+
+// Enqueues all files as parse units (splitting large plain files into
+// chunk_bytes byte ranges at newline boundaries) and starts n_threads
+// workers.  Returns the number of units, or -1 on error.
+int64_t rdf_ingest_begin(Ingest* ing, const char** paths, int64_t n_paths,
+                         int tabs, int expect_quad, int skip_comments,
+                         int n_threads, int64_t chunk_bytes) {
+  if (ing->par) {
+    ing->error = "streaming ingest already begun";
+    return -1;
+  }
+  if (ing->finalized || !ing->triples.empty()) {
+    ing->error = "handle already used by the serial API";
+    return -1;
+  }
+  if (chunk_bytes <= 0) chunk_bytes = 64ll << 20;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > 256) n_threads = 256;
+  auto par = std::make_unique<Parallel>();
+  par->tabs = tabs != 0;
+  par->quad = expect_quad != 0;
+  par->skip_comments = skip_comments != 0;
+  for (int64_t i = 0; i < n_paths; i++) {
+    std::string path(paths[i]);
+    int64_t size = file_size(paths[i]);
+    ing->stats.n_files++;
+    if (!ends_with_gz(path) && size > chunk_bytes) {
+      for (int64_t off = 0; off < size; off += chunk_bytes) {
+        Unit u;
+        u.path = path;
+        u.whole = false;
+        u.off = off;
+        u.len = std::min(chunk_bytes, size - off);
+        par->units.push_back(std::move(u));
+      }
+    } else {
+      Unit u;  // gz (unsplittable) or small plain file: one whole-file unit
+      u.path = path;
+      par->units.push_back(std::move(u));
+    }
+  }
+  par->results.resize(par->units.size());
+  par->done.assign(par->units.size(), 0);
+  par->shards.reserve(n_threads);
+  for (int t = 0; t < n_threads; t++)
+    par->shards.push_back(std::make_unique<ThreadShard>());
+  ing->stats.n_threads = n_threads;
+  ing->stats.n_units = static_cast<int64_t>(par->units.size());
+  Parallel* p = par.get();
+  ing->par = std::move(par);
+  for (int t = 0; t < n_threads; t++)
+    p->workers.emplace_back(worker_main, p, t, &ing->stats);
+  return static_cast<int64_t>(p->units.size());
+}
+
+// Blocks until the next unit (in unit order) is parsed; returns its row
+// count (possibly 0), -1 when the stream is exhausted, -2 on parse error
+// (rdf_ingest_error holds the first failing unit's message).
+int64_t rdf_ingest_next_block(Ingest* ing) {
+  Parallel* p = ing->par.get();
+  if (!p) {
+    ing->error = "rdf_ingest_begin was not called";
+    return -2;
+  }
+  if (p->next_deliver >= p->units.size()) {
+    p->drained = true;
+    p->join_workers();
+    return -1;
+  }
+  size_t u = p->next_deliver;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (!p->done[u]) {
+      ing->stats.queue_stalls++;
+      auto t0 = Clock::now();
+      p->cv.wait(lk, [&] { return p->done[u] != 0; });
+      ing->stats.stall_ns += ns_since(t0);
+    }
+  }
+  UnitResult& r = p->results[u];
+  if (!r.error.empty()) {
+    ing->error = r.error;
+    p->join_workers();
+    return -2;
+  }
+  p->cur_block = static_cast<int64_t>(u);
+  p->next_deliver++;
+  return static_cast<int64_t>(r.triples.size() / 3);
+}
+
+int rdf_ingest_block_thread(Ingest* ing) {
+  Parallel* p = ing->par.get();
+  if (!p || p->cur_block < 0) return -1;
+  return p->results[p->cur_block].thread;
+}
+
+// Copies the current block's (n, 3) provisional-id rows out and frees them.
+void rdf_ingest_block_copy(Ingest* ing, int32_t* out) {
+  Parallel* p = ing->par.get();
+  if (!p || p->cur_block < 0) return;
+  auto& t = p->results[p->cur_block].triples;
+  memcpy(out, t.data(), t.size() * sizeof(int32_t));
+  std::vector<int32_t>().swap(t);  // streamed blocks never linger
+}
+
+// Merges the per-thread interners into the global byte-sorted dictionary:
+// crc32-shard partition -> parallel per-shard dedupe+sort -> S-way rank
+// merge -> per-thread local->global tables.  Returns the number of distinct
+// values, or -1 on error.  Requires the stream to be drained first.
+int64_t rdf_ingest_stream_finish(Ingest* ing) {
+  Parallel* p = ing->par.get();
+  if (!p) {
+    ing->error = "rdf_ingest_begin was not called";
+    return -1;
+  }
+  if (!p->drained) {
+    ing->error = "stream not drained; pull blocks until -1 first";
+    return -1;
+  }
+  if (ing->finalized) return static_cast<int64_t>(ing->sorted_vals.size());
+  p->join_workers();
+  const int n_threads = static_cast<int>(p->shards.size());
+  const int S = n_threads;  // merge shards (same partition fn as dictionary.py)
+
+  // Partition: per-thread local ids bucketed by crc32(value) % S.
+  auto t0 = Clock::now();
+  parallel_for(n_threads, n_threads, [&](int64_t ti) {
+    ThreadShard* sh = p->shards[ti].get();
+    sh->buckets.assign(S, {});
+    size_t nvals = sh->in.by_id.size();
+    sh->to_global.assign(nvals, 0);
+    for (size_t lid = 0; lid < nvals; lid++) {
+      const std::string* s = sh->in.by_id[lid];
+      uint32_t h = crc32(0L, reinterpret_cast<const Bytef*>(s->data()),
+                         static_cast<uInt>(s->size()));
+      sh->buckets[h % S].push_back(static_cast<int32_t>(lid));
+    }
+  });
+  int64_t partition_ns = ns_since(t0);
+
+  // Dedupe+sort per shard (the parallel dictionary build).  Each entry's
+  // in-shard rank lands in its thread's to_global slot (upgraded to the
+  // global rank below).
+  struct Entry {
+    std::string_view v;
+    int32_t thread;
+    int32_t lid;
+  };
+  std::vector<std::vector<std::string_view>> shard_distinct(S);
+  t0 = Clock::now();
+  parallel_for(S, n_threads, [&](int64_t s) {
+    std::vector<Entry> entries;
+    size_t total = 0;
+    for (int t = 0; t < n_threads; t++)
+      total += p->shards[t]->buckets[s].size();
+    entries.reserve(total);
+    for (int t = 0; t < n_threads; t++)
+      for (int32_t lid : p->shards[t]->buckets[s])
+        entries.push_back(
+            {std::string_view(*p->shards[t]->in.by_id[lid]), t, lid});
+    std::sort(entries.begin(), entries.end(),
+              [](const Entry& a, const Entry& b) { return a.v < b.v; });
+    auto& distinct = shard_distinct[s];
+    int32_t rank = -1;
+    std::string_view prev;
+    for (const Entry& e : entries) {
+      if (rank < 0 || e.v != prev) {
+        rank++;
+        prev = e.v;
+        distinct.push_back(e.v);
+      }
+      p->shards[e.thread]->to_global[e.lid] = rank;  // in-shard rank, for now
+    }
+  });
+  ing->stats.intern_ns += ns_since(t0);
+
+  // S-way merge of the shard-sorted runs into the byte-sorted global order
+  // (shards are hash-disjoint, so no cross-shard duplicates).
+  t0 = Clock::now();
+  int64_t total = 0;
+  for (int s = 0; s < S; s++) total += shard_distinct[s].size();
+  if (total >= (1ll << 31) - 1) {
+    ing->error = "dictionary exceeds int32 id space";
+    return -1;
+  }
+  ing->sorted_vals.reserve(total);
+  std::vector<std::vector<int32_t>> shard_to_global(S);
+  std::vector<size_t> cursor(S, 0);
+  for (int s = 0; s < S; s++)
+    shard_to_global[s].resize(shard_distinct[s].size());
+  for (int64_t rank = 0; rank < total; rank++) {
+    int best = -1;
+    for (int s = 0; s < S; s++) {
+      if (cursor[s] >= shard_distinct[s].size()) continue;
+      if (best < 0 ||
+          shard_distinct[s][cursor[s]] < shard_distinct[best][cursor[best]])
+        best = s;
+    }
+    shard_to_global[best][cursor[best]] = static_cast<int32_t>(rank);
+    ing->sorted_vals.push_back(shard_distinct[best][cursor[best]]);
+    cursor[best]++;
+  }
+  ing->stats.merge_ns += partition_ns + ns_since(t0);
+
+  // Upgrade the per-thread tables from in-shard ranks to global ranks.
+  t0 = Clock::now();
+  parallel_for(n_threads, n_threads, [&](int64_t ti) {
+    ThreadShard* sh = p->shards[ti].get();
+    for (int s = 0; s < S; s++)
+      for (int32_t lid : sh->buckets[s])
+        sh->to_global[lid] = shard_to_global[s][sh->to_global[lid]];
+    sh->buckets.clear();
+  });
+  ing->stats.remap_ns += ns_since(t0);
+
+  ing->sorted_offsets.assign(total + 1, 0);
+  int64_t off = 0;
+  for (int64_t i = 0; i < total; i++) {
+    ing->sorted_offsets[i] = off;
+    off += static_cast<int64_t>(ing->sorted_vals[i].size());
+  }
+  ing->sorted_offsets[total] = off;
+  ing->values_bytes = off;
+  ing->finalized = true;
+  return total;
+}
+
+int64_t rdf_ingest_thread_vocab(Ingest* ing, int thread_idx) {
+  Parallel* p = ing->par.get();
+  if (!p || thread_idx < 0 ||
+      thread_idx >= static_cast<int>(p->shards.size()))
+    return -1;
+  return static_cast<int64_t>(p->shards[thread_idx]->in.by_id.size());
+}
+
+// Copies thread thread_idx's local->global id table (rdf_ingest_thread_vocab
+// entries); only valid after rdf_ingest_stream_finish.
+void rdf_ingest_thread_remap(Ingest* ing, int thread_idx, int32_t* out) {
+  Parallel* p = ing->par.get();
+  if (!p || !ing->finalized || thread_idx < 0 ||
+      thread_idx >= static_cast<int>(p->shards.size()))
+    return;
+  auto& tg = p->shards[thread_idx]->to_global;
+  memcpy(out, tg.data(), tg.size() * sizeof(int32_t));
+}
+
+// Ingest telemetry: 12 doubles —
+// [bytes_read, read_ms, parse_ms, intern_ms, merge_ms, remap_ms, n_threads,
+//  n_units, queue_stalls, stall_ms, n_files, reserved].
+// Worker-phase ms are SUMS across threads (divide by n_threads for wall).
+void rdf_ingest_stats(Ingest* ing, double* out) {
+  const Stats& s = ing->stats;
+  out[0] = static_cast<double>(s.bytes_read.load());
+  out[1] = s.read_ns.load() / 1e6;
+  out[2] = s.parse_ns.load() / 1e6;
+  out[3] = s.intern_ns / 1e6;
+  out[4] = s.merge_ns / 1e6;
+  out[5] = s.remap_ns / 1e6;
+  out[6] = static_cast<double>(s.n_threads);
+  out[7] = static_cast<double>(s.n_units);
+  out[8] = static_cast<double>(s.queue_stalls.load());
+  out[9] = s.stall_ns.load() / 1e6;
+  out[10] = static_cast<double>(s.n_files);
+  out[11] = 0.0;
 }
 
 }  // extern "C"

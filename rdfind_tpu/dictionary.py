@@ -15,8 +15,21 @@ string join value).
 from __future__ import annotations
 
 import dataclasses
+import zlib
 
 import numpy as np
+
+
+def value_shard(value, num_shards: int) -> int:
+    """THE hash-partition function for sharded interning.
+
+    Every layer that splits a dictionary by value must agree on one partition
+    or merged ids diverge: the multi-host hash-partitioned interning
+    (runtime/multihost_ingest._value_owner), and the native parallel ingest's
+    per-thread interner merge (native/rdfind_native.cpp, crc32 % S) both use
+    exactly this: crc32 over the UTF-8 bytes, mod the shard count.
+    """
+    return zlib.crc32(str(value).encode("utf-8")) % num_shards
 
 
 @dataclasses.dataclass

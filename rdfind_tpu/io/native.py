@@ -6,11 +6,25 @@ path for large dumps.  The pure-Python path (io/reader.py + io/ntriples.py +
 dictionary.intern_triples) remains the reference implementation and the
 fallback when the shared library is absent and cannot be built.
 
-Semantics: identical ids/values for valid-UTF-8 inputs (byte-sort order ==
-np.unique's code-point order).  For invalid UTF-8 the native path is strictly
-more exact: it interns raw bytes (distinct byte strings stay distinct), while
-the Python reader's errors="replace" can conflate them; exported values are
-decoded with errors="replace" either way.
+Parallelism: ``RDFIND_INGEST_THREADS`` (default: all cores; ``1`` restores the
+single-threaded serial engine) runs the parse as a work-stealing unit queue —
+whole files, plus byte-range chunks of large plain files split at newline
+boundaries (``RDFIND_INGEST_CHUNK_BYTES``, default 64 MiB; gz members cannot
+be seek-split, so .gz parallelism is at file granularity).  Committed triple
+blocks stream back IN INPUT ORDER while later units still parse
+(:class:`IngestStream`), so the caller's host-side assembly — and any staging
+it feeds, e.g. runtime/multihost_ingest.py's per-host table build — overlaps
+the parse instead of following it.  Ids are bit-identical to the serial path
+by construction: the merge stage hash-partitions the per-thread interners
+with the SAME crc32 partition function as the multi-host dictionary
+(dictionary.value_shard), dedupes shards in parallel, and byte-sort-merges
+them into the global rank order.
+
+Semantics: identical ids/values to the Python path for valid-UTF-8 inputs
+(byte-sort order == np.unique's code-point order).  For invalid UTF-8 the
+native path is strictly more exact: it interns raw bytes (distinct byte
+strings stay distinct), while the Python reader's errors="replace" can
+conflate them; exported values are decoded with errors="replace" either way.
 """
 
 from __future__ import annotations
@@ -18,22 +32,47 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import time
 
 import numpy as np
 
 from ..dictionary import Dictionary
 
-_SO_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "_rdfind_native.so")
+_SO_PATH = os.environ.get("RDFIND_NATIVE_SO") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "_rdfind_native.so")
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
 
 _lib = None
 _lib_error: str | None = None
 
+# rdf_ingest_stats lane order (native/rdfind_native.cpp).
+_STAT_FIELDS = ("bytes_read", "read_ms", "parse_ms", "intern_ms", "merge_ms",
+                "remap_ms", "n_threads", "n_units", "queue_stalls",
+                "queue_stall_ms", "n_files", "_reserved")
+_N_STATS = len(_STAT_FIELDS)
+
+DEFAULT_CHUNK_BYTES = 64 << 20
+
 
 class NativeIngestError(RuntimeError):
     pass
+
+
+def ingest_threads(threads: int | None = None) -> int:
+    """Resolved worker count: explicit arg > RDFIND_INGEST_THREADS > cores."""
+    if threads is None:
+        env = os.environ.get("RDFIND_INGEST_THREADS", "")
+        threads = int(env) if env.strip() else (os.cpu_count() or 1)
+    return max(1, int(threads))
+
+
+def ingest_chunk_bytes(chunk_bytes: int | None = None) -> int:
+    """Resolved plain-file split size (gz files never split)."""
+    if chunk_bytes is None:
+        env = os.environ.get("RDFIND_INGEST_CHUNK_BYTES", "")
+        chunk_bytes = int(env) if env.strip() else DEFAULT_CHUNK_BYTES
+    return max(1, int(chunk_bytes))
 
 
 def _build() -> bool:
@@ -65,6 +104,23 @@ def _bind(lib):
     lib.rdf_ingest_values_bytes.restype = ctypes.c_int64
     lib.rdf_ingest_get_values.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                           ctypes.c_void_p]
+    lib.rdf_ingest_begin.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int64]
+    lib.rdf_ingest_begin.restype = ctypes.c_int64
+    lib.rdf_ingest_next_block.argtypes = [ctypes.c_void_p]
+    lib.rdf_ingest_next_block.restype = ctypes.c_int64
+    lib.rdf_ingest_block_thread.argtypes = [ctypes.c_void_p]
+    lib.rdf_ingest_block_thread.restype = ctypes.c_int
+    lib.rdf_ingest_block_copy.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.rdf_ingest_stream_finish.argtypes = [ctypes.c_void_p]
+    lib.rdf_ingest_stream_finish.restype = ctypes.c_int64
+    lib.rdf_ingest_thread_vocab.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rdf_ingest_thread_vocab.restype = ctypes.c_int64
+    lib.rdf_ingest_thread_remap.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                            ctypes.c_void_p]
+    lib.rdf_ingest_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     return lib
 
 
@@ -81,7 +137,9 @@ def load():
         return None
     try:
         _lib = _bind(ctypes.CDLL(_SO_PATH))
-    except OSError as e:
+    except (OSError, AttributeError) as e:
+        # AttributeError == a stale .so predating the streaming API.
+        _lib = None
         _lib_error = str(e)
         return None
     return _lib
@@ -91,16 +149,213 @@ def available() -> bool:
     return load() is not None
 
 
-def ingest_files(paths, tabs: bool = False, expect_quad: bool = False,
-                 skip_comments: bool = True):
-    """Parse + intern all files natively.  Returns ((N, 3) int32 ids, Dictionary).
+def _read_stats(lib, h) -> dict:
+    buf = (ctypes.c_double * _N_STATS)()
+    lib.rdf_ingest_stats(h, buf)
+    out = {k: float(v) for k, v in zip(_STAT_FIELDS, buf) if k != "_reserved"}
+    for k in ("bytes_read", "n_threads", "n_units", "queue_stalls",
+              "n_files"):
+        out[k] = int(out[k])
+    return out
 
-    Raises NativeIngestError on parse errors (same failure surface as the
-    Python parser's ParseError) or if the library is unavailable.
+
+def _values_from_buffer(raw: bytes, offsets: np.ndarray):
+    """Per-value UTF-8 decode of the exported dictionary blob.
+
+    Probes losslessness per value, not on the concatenated blob: an invalid
+    suffix of one value can splice with an invalid prefix of the next into a
+    valid sequence (b"\\xc3" + b"\\xa9" == "é"), so a whole-blob decode can
+    succeed while individual values are invalid.  Returns (values, lossless).
     """
+    n_values = len(offsets) - 1
+    values = np.empty(n_values, object)
+    lossless = True
+    for i in range(n_values):
+        chunk = raw[offsets[i]:offsets[i + 1]]
+        try:
+            values[i] = chunk.decode("utf-8")
+        except UnicodeDecodeError:
+            values[i] = chunk.decode(errors="replace")
+            lossless = False
+    return values, lossless
+
+
+def canonicalize(ids: np.ndarray, values: np.ndarray, lossless: bool):
+    """Invalid UTF-8: errors="replace" can reorder or even conflate values
+    relative to the native byte-sort ranks, breaking Dictionary's
+    sorted-unique invariant.  Re-canonicalize exactly like the Python path
+    (np.unique on decoded strings) and remap the ids."""
+    if lossless or not len(values):
+        return ids, Dictionary(values)
+    uniques, inverse = np.unique(values, return_inverse=True)
+    ids = inverse.astype(np.int32)[ids]
+    return ids, Dictionary(uniques)
+
+
+class IngestStream:
+    """Streaming parallel ingest: committed triple blocks while files parse.
+
+    Usage::
+
+        stream = IngestStream(paths, tabs=..., expect_quad=...)
+        for block, thread_id in stream:   # provisional thread-local ids
+            ...stage block...             # overlaps the ongoing parse
+        remaps = stream.finish()          # thread-local id -> global rank
+        values = stream.values()          # byte-sorted distinct values
+        st = stream.stats()
+        stream.close()
+
+    Blocks arrive in INPUT ORDER (file order; a split file's chunks in offset
+    order), so concatenating them reproduces the serial triple order exactly;
+    applying ``remaps[thread_id]`` to each block yields the final global ids,
+    bit-identical to the serial engine.
+    """
+
+    def __init__(self, paths, *, tabs: bool = False, expect_quad: bool = False,
+                 skip_comments: bool = True, threads: int | None = None,
+                 chunk_bytes: int | None = None):
+        lib = load()
+        if lib is None:
+            raise NativeIngestError(f"native ingest unavailable: {_lib_error}")
+        self._lib = lib
+        self._h = lib.rdf_ingest_new()
+        self.n_threads = ingest_threads(threads)
+        encoded = [os.fspath(p).encode() for p in paths]
+        arr = (ctypes.c_char_p * max(len(encoded), 1))(*encoded)
+        n_units = lib.rdf_ingest_begin(
+            self._h, arr, len(encoded), int(tabs), int(expect_quad),
+            int(skip_comments), self.n_threads,
+            ingest_chunk_bytes(chunk_bytes))
+        if n_units < 0:
+            msg = lib.rdf_ingest_error(self._h).decode(errors="replace")
+            self.close()
+            raise NativeIngestError(msg)
+        self._finished = False
+
+    def __iter__(self):
+        lib, h = self._lib, self._h
+        while True:
+            n = lib.rdf_ingest_next_block(h)
+            if n == -1:
+                return
+            if n < 0:
+                raise NativeIngestError(
+                    lib.rdf_ingest_error(h).decode(errors="replace"))
+            block = np.empty((int(n), 3), np.int32)
+            if n:
+                lib.rdf_ingest_block_copy(
+                    h, block.ctypes.data_as(ctypes.c_void_p))
+            yield block, int(lib.rdf_ingest_block_thread(h))
+
+    def finish(self) -> list[np.ndarray]:
+        """Merge the per-thread interners; returns per-thread local->global
+        remap tables.  Only valid after the block iterator is exhausted."""
+        n_values = self._lib.rdf_ingest_stream_finish(self._h)
+        if n_values < 0:
+            raise NativeIngestError(
+                self._lib.rdf_ingest_error(self._h).decode(errors="replace"))
+        self._finished = True
+        remaps = []
+        for t in range(self.n_threads):
+            vocab = int(self._lib.rdf_ingest_thread_vocab(self._h, t))
+            r = np.empty(max(vocab, 1), np.int32)
+            if vocab:
+                self._lib.rdf_ingest_thread_remap(
+                    self._h, t, r.ctypes.data_as(ctypes.c_void_p))
+            remaps.append(r[:vocab])
+        self._n_values = int(n_values)
+        return remaps
+
+    def raw_values(self) -> tuple[bytes, np.ndarray]:
+        """(concatenated byte blob, offsets) of the sorted distinct values."""
+        nbytes = int(self._lib.rdf_ingest_values_bytes(self._h))
+        buf = np.empty(max(nbytes, 1), np.uint8)
+        offsets = np.empty(self._n_values + 1, np.int64)
+        self._lib.rdf_ingest_get_values(
+            self._h, buf.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.c_void_p))
+        return buf.tobytes()[:nbytes], offsets
+
+    def decoded_values(self):
+        """(values, lossless): the sorted distinct values, UTF-8-decoded
+        per value; pair with :func:`canonicalize` to build the Dictionary."""
+        raw, offsets = self.raw_values()
+        return _values_from_buffer(raw, offsets)
+
+    def stats(self) -> dict:
+        return _read_stats(self._lib, self._h)
+
+    def close(self):
+        if self._h is not None:
+            self._lib.rdf_ingest_free(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class BlockAssembler:
+    """Incremental (N, 3) table assembly from streamed blocks.
+
+    Grows the backing array by doubling so each committed block costs one
+    memcpy DURING the parse (instead of a full second concatenation pass
+    after it), and records per-block spans so the thread-local -> global id
+    remap applies vectorized per span at finish."""
+
+    def __init__(self):
+        self._buf = np.empty((1 << 14, 3), np.int32)
+        self._n = 0
+        self._spans: list[tuple[int, int, int]] = []  # (lo, hi, thread)
+
+    def add(self, block: np.ndarray, thread_id: int):
+        n = block.shape[0]
+        if n == 0:
+            return
+        while self._n + n > self._buf.shape[0]:
+            grown = np.empty((self._buf.shape[0] * 2, 3), np.int32)
+            grown[:self._n] = self._buf[:self._n]
+            self._buf = grown
+        self._buf[self._n:self._n + n] = block
+        self._spans.append((self._n, self._n + n, thread_id))
+        self._n += n
+
+    def finalize(self, remaps: list[np.ndarray]) -> np.ndarray:
+        """Applies the per-thread remap tables in place; returns the table."""
+        ids = self._buf[:self._n]
+        for lo, hi, t in self._spans:
+            ids[lo:hi] = remaps[t][ids[lo:hi]]
+        return ids
+
+
+def _ingest_parallel(paths, tabs, expect_quad, skip_comments, threads,
+                     chunk_bytes, stats):
+    t_wall = time.perf_counter()
+    with IngestStream(paths, tabs=tabs, expect_quad=expect_quad,
+                      skip_comments=skip_comments, threads=threads,
+                      chunk_bytes=chunk_bytes) as stream:
+        asm = BlockAssembler()
+        for block, thread_id in stream:
+            asm.add(block, thread_id)
+        remaps = stream.finish()
+        t0 = time.perf_counter()
+        ids = asm.finalize(remaps)
+        remap_ms = (time.perf_counter() - t0) * 1000.0
+        raw, offsets = stream.raw_values()
+        st = stream.stats()
+    values, lossless = _values_from_buffer(raw, offsets)
+    ids, dictionary = canonicalize(ids, values, lossless)
+    if stats is not None:
+        st["remap_ms"] += remap_ms  # host-side block rewrite rides the phase
+        publish_stats(stats, st, ids.shape[0], len(dictionary), t_wall)
+    return ids, dictionary
+
+
+def _ingest_serial(paths, tabs, expect_quad, skip_comments, stats):
     lib = load()
-    if lib is None:
-        raise NativeIngestError(f"native ingest unavailable: {_lib_error}")
+    t_wall = time.perf_counter()
     h = lib.rdf_ingest_new()
     try:
         for p in paths:
@@ -115,33 +370,53 @@ def ingest_files(paths, tabs: bool = False, expect_quad: bool = False,
         if n_triples:
             lib.rdf_ingest_get_triples(h, ids.ctypes.data_as(ctypes.c_void_p))
         nbytes = lib.rdf_ingest_values_bytes(h)
-        buf = np.empty(nbytes, np.uint8)
+        buf = np.empty(max(nbytes, 1), np.uint8)
         offsets = np.empty(n_values + 1, np.int64)
         lib.rdf_ingest_get_values(
             h, buf.ctypes.data_as(ctypes.c_void_p),
             offsets.ctypes.data_as(ctypes.c_void_p))
+        st = _read_stats(lib, h)
     finally:
         lib.rdf_ingest_free(h)
-    raw = buf.tobytes()
-    values = np.empty(n_values, object)
-    # Probe losslessness per value, not on the concatenated blob: an invalid
-    # suffix of one value can splice with an invalid prefix of the next into a
-    # valid sequence (b"\xc3" + b"\xa9" == "é"), so a whole-blob decode can
-    # succeed while individual values are invalid.
-    lossless = True
-    for i in range(n_values):
-        chunk = raw[offsets[i]:offsets[i + 1]]
-        try:
-            values[i] = chunk.decode("utf-8")
-        except UnicodeDecodeError:
-            values[i] = chunk.decode(errors="replace")
-            lossless = False
-    if not lossless and n_values:
-        # Invalid UTF-8: errors="replace" can reorder or even conflate values
-        # relative to the native byte-sort ranks, breaking Dictionary's
-        # sorted-unique invariant.  Re-canonicalize exactly like the Python
-        # path (np.unique on decoded strings) and remap the ids.
-        uniques, inverse = np.unique(values, return_inverse=True)
-        ids = inverse.astype(np.int32)[ids]
-        values = uniques
-    return ids, Dictionary(values)
+    values, lossless = _values_from_buffer(buf.tobytes()[:nbytes], offsets)
+    ids, dictionary = canonicalize(ids, values, lossless)
+    if stats is not None:
+        publish_stats(stats, st, ids.shape[0], len(dictionary), t_wall)
+    return ids, dictionary
+
+
+def publish_stats(stats: dict, st: dict, n_triples: int, n_values: int,
+                   t_wall: float) -> None:
+    wall_s = max(time.perf_counter() - t_wall, 1e-9)
+    st["wall_ms"] = round(wall_s * 1000.0, 1)
+    st["triples"] = int(n_triples)
+    st["values"] = int(n_values)
+    st["triples_per_sec"] = round(n_triples / wall_s, 1)
+    st["bytes_per_sec"] = round(st["bytes_read"] / wall_s, 1)
+    for k in ("read_ms", "parse_ms", "intern_ms", "merge_ms", "remap_ms",
+              "queue_stall_ms"):
+        st[k] = round(st[k], 2)
+    stats.update(st)
+
+
+def ingest_files(paths, tabs: bool = False, expect_quad: bool = False,
+                 skip_comments: bool = True, *, threads: int | None = None,
+                 chunk_bytes: int | None = None, stats: dict | None = None):
+    """Parse + intern all files natively.  Returns ((N, 3) int32 ids, Dictionary).
+
+    ``threads`` (default: RDFIND_INGEST_THREADS, else all cores) > 1 runs the
+    parallel streaming engine; ``1`` restores the serial reference engine.
+    Output is bit-identical either way.  ``stats``, when a dict, receives the
+    ingest telemetry (bytes/s, triples/s, per-phase ms, thread count, queue
+    stalls — see README "Ingest performance").
+
+    Raises NativeIngestError on parse errors (same failure surface as the
+    Python parser's ParseError) or if the library is unavailable.
+    """
+    if load() is None:
+        raise NativeIngestError(f"native ingest unavailable: {_lib_error}")
+    n_threads = ingest_threads(threads)
+    if n_threads <= 1:
+        return _ingest_serial(paths, tabs, expect_quad, skip_comments, stats)
+    return _ingest_parallel(paths, tabs, expect_quad, skip_comments,
+                            n_threads, chunk_bytes, stats)

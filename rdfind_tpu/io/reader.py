@@ -69,6 +69,22 @@ def _open_raw(path: str):
     return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
 
 
+def input_sizes(paths) -> list[int]:
+    """On-disk byte size per input file (0 for unstatable paths).
+
+    Feeds ingest telemetry and the parallel ingest's unit planning:
+    uncompressed bytes are what the byte-range chunker splits, while .gz
+    sizes only bound file-level parallelism (gz members cannot be
+    seek-split, so they always parse as one unit)."""
+    out = []
+    for p in paths:
+        try:
+            out.append(os.path.getsize(p))
+        except OSError:
+            out.append(0)
+    return out
+
+
 def sniff_encoding(path: str, default: str = "utf-8") -> str:
     """Detect a BOM (gz-aware) and return the matching codec; else ``default``."""
     with _open_raw(path) as f:

@@ -712,6 +712,17 @@ class _PairCapsExhausted(Exception):
         self.msg = msg
 
 
+# Exchange-site lane counts for the communication ledger
+# (exchange.log_exchange): payload columns + validity lane (+ reply lane for
+# count exchanges).  Derived from the device code above — update together.
+_LANES_FREQ = 27        # 6 count exchanges: 3 unary (4 lanes) + 3 binary (5)
+_LANES_EXCHANGE_A = 5   # [jv, code, v1, v2] + validity
+_LANES_EXCHANGE_B = 4   # [code, v1, v2] + validity
+_LANES_REBALANCE = 5    # [jv, code, v1, v2] + validity
+_LANES_EXCHANGE_C = 8   # 6 pair-key cols + count + validity
+_LANES_GIANT = 6        # [jv, code, v1, v2, flag] + validity (all_gather)
+
+
 class _Pipeline:
     """Planned, retrying execution of the sharded programs (host side).
 
@@ -756,6 +767,12 @@ class _Pipeline:
 
         # P2: lines + downstream load measurement (retry on freq/A overflow).
         for _ in range(max_retries):
+            if use_fis:
+                exchange.log_exchange(stats, "freq", num_dev=self.num_dev,
+                                      capacity=self.cap_f, lanes=_LANES_FREQ)
+            exchange.log_exchange(stats, "exchange_a", num_dev=self.num_dev,
+                                  capacity=self.cap_a,
+                                  lanes=_LANES_EXCHANGE_A)
             out = _lines_step(
                 self._triples, self._n_valid, jnp.int32(min_support),
                 mesh=mesh, projections=projections, use_fis=use_fis,
@@ -767,7 +784,9 @@ class _Pipeline:
                 ovf = np.maximum(ovf, 1)
             if int(ovf.sum()) == 0:
                 break
-            self._count_overflow_retry("line-building")
+            self._count_overflow_retry(
+                "line-building",
+                site="freq" if int(ovf[0]) > 0 else "exchange_a")
             if ovf[0] > 0:
                 self.cap_f = segments.pow2_capacity(2 * self.cap_f + int(ovf[0]))
             if ovf[1] > 0:
@@ -813,6 +832,9 @@ class _Pipeline:
 
         # P3: capture table (retry on B overflow).
         for _ in range(max_retries):
+            exchange.log_exchange(stats, "exchange_b", num_dev=self.num_dev,
+                                  capacity=self.cap_b,
+                                  lanes=_LANES_EXCHANGE_B)
             out = _captures_step(*self.lines, self.n_rows, mesh=mesh,
                                  cap_exchange_b=self.cap_b)
             *tbl, n_caps, ovf_b = out
@@ -821,7 +843,7 @@ class _Pipeline:
                 ovf_b = max(ovf_b, 1)
             if ovf_b == 0:
                 break
-            self._count_overflow_retry("capture-count")
+            self._count_overflow_retry("capture-count", site="exchange_b")
             self.cap_b = segments.pow2_capacity(2 * self.cap_b + ovf_b)
             _check_caps(exchange_b=self.num_dev * self.cap_b)
         else:
@@ -900,6 +922,10 @@ class _Pipeline:
         moved_dest = np.zeros(h, np.int32)
         moved_dest[:len(mj)] = md
         for _ in range(self.max_retries):
+            exchange.log_exchange(self.stats, "rebalance",
+                                  num_dev=self.num_dev, capacity=cap_move,
+                                  lanes=_LANES_REBALANCE,
+                                  rows=int(lens[moving].sum()))
             out = _rebalance_step(*self.lines, self.n_rows,
                                   moved_jv, moved_dest,
                                   mesh=self.mesh, cap_move=cap_move)
@@ -909,7 +935,7 @@ class _Pipeline:
                 ovf = max(ovf, 1)
             if ovf == 0:
                 break
-            self._count_overflow_retry("rebalance")
+            self._count_overflow_retry("rebalance", site="rebalance")
             cap_move = segments.pow2_capacity(2 * cap_move + ovf)
         else:
             # Ladder rung "skip": rebalancing is an output-neutral placement
@@ -927,11 +953,13 @@ class _Pipeline:
         self.lines = cols
         self.n_rows = n_rows
 
-    def _count_overflow_retry(self, phase: str) -> None:
+    def _count_overflow_retry(self, phase: str, site: str | None = None) -> None:
         """Ledger + telemetry for one capacity-grow retry (ladder rung 0)."""
         if self.stats is not None:
             self.stats["n_overflow_retries"] = (
                 self.stats.get("n_overflow_retries", 0) + 1)
+            if site is not None:
+                exchange.log_exchange_retry(self.stats, site)
         faults.record_degradation(self.stats, phase, "grow")
 
     def _overflow_exhausted(self, phase: str, detail: str):
@@ -1126,6 +1154,19 @@ class _Pipeline:
                 if parts[p_next] is not None:  # resumed from a checkpoint
                     p_next += 1
                     continue
+                # Every dispatched pass moves its full fixed-shape exchange-C
+                # and giant-gather buffers — including optimistically
+                # dispatched passes later discarded by a rollback, so the
+                # ledger records dispatches, not committed passes.
+                exchange.log_exchange(self.stats, "exchange_c",
+                                      num_dev=self.num_dev,
+                                      capacity=self.cap_c,
+                                      lanes=_LANES_EXCHANGE_C)
+                exchange.log_exchange(
+                    self.stats, "giant_gather", num_dev=self.num_dev,
+                    capacity=min(self.cap_g,
+                                 self.lines[0].shape[0] // self.num_dev),
+                    lanes=_LANES_GIANT)
                 cols, n_out, tele = step(self._pass_args(p_next))
                 dispatch.stage_to_host([tele])
                 inflight.append((p_next, cols, n_out, tele))
@@ -1149,7 +1190,7 @@ class _Pipeline:
                     raise _PairCapsExhausted(
                         f"{what} overflow persisted after {self.max_retries} "
                         f"retries ({np.asarray(ovf).tolist()})")
-                self._count_overflow_retry(what)
+                self._count_overflow_retry(what, site="exchange_c")
                 inflight.clear()  # discard optimistically dispatched successors
                 self._grow_pair_caps(ovf)
                 d.n_cap_retries += 1

@@ -358,6 +358,7 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
     its file subset; no host materializes the full triple table)."""
     from . import multihost_ingest
 
+    stats: dict = {}
     paths, is_nq = _resolve_inputs(cfg)
     mesh = make_mesh(cfg.n_devices if cfg.n_devices > 1 else None)
 
@@ -411,7 +412,7 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
             partition_dictionary={"auto": None, "partitioned": True,
                                   "replicated": False}[cfg.interning],
             transform=transform, cache=ckpt, cache_fp=ingest_fp,
-            cache_hit=hit)
+            cache_hit=hit, stats=stats)
         # The counter means "the run skipped parsing" — only true when EVERY
         # host hit its cache (some hosts re-parsing is a partial resume the
         # primary's report must not overstate).
@@ -423,6 +424,7 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
                                                        ingest)
     counters["input-triples"] = total
     counters["distinct-values"] = len(dictionary)
+    _ingest_counters(counters, stats)
 
     if cfg.only_read:
         # The read-only probe (replicated-path parity; note the sharded ingest
@@ -482,7 +484,6 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
               "(association rules are mined from the frequent-item sets)",
               file=sys.stderr)
 
-    stats: dict = {}
     skew = _skew_from_cfg(cfg)
     # Strategy dispatch over the preshard — all four families run natively on
     # the pre-built global arrays (the reference's default strategy is fully
@@ -625,6 +626,7 @@ def _run_profiled(cfg: Config) -> RunResult:
 def _run(cfg: Config) -> RunResult:
     phases = _Phases()
     counters: dict = {}
+    stats: dict = {}
 
     if cfg.print_plan and _is_primary():
         import json as _json
@@ -662,9 +664,14 @@ def _run(cfg: Config) -> RunResult:
     if ids is None:
         if use_native:
             paths, is_nq = _resolve_inputs(cfg)
+            ingest_stats: dict = {}
             ids, dictionary = phases.run(
                 "read+parse", lambda: native.ingest_files(
-                    paths, tabs=cfg.tabs, expect_quad=is_nq))
+                    paths, tabs=cfg.tabs, expect_quad=is_nq,
+                    stats=ingest_stats))
+            if ingest_stats:
+                stats["ingest"] = ingest_stats
+                _ingest_counters(counters, stats)
             counters["input-triples"] = ids.shape[0]
             phases.timings["intern"] = 0.0  # folded into the native pass
         else:
@@ -735,8 +742,6 @@ def _run(cfg: Config) -> RunResult:
         print("note: --use-ars has no effect without --use-fis "
               "(association rules are mined from the frequent-item sets)",
               file=sys.stderr)
-
-    stats: dict = {}
 
     def discover():
         if cfg.n_devices > 1:
@@ -857,10 +862,47 @@ def _run(cfg: Config) -> RunResult:
     return RunResult(table, dictionary, ids, counters, phases.timings)
 
 
+def _ingest_counters(counters: dict, stats: dict) -> None:
+    """Headline ingest telemetry -> counters (so -c reports it even on the
+    only-read/only-join probes, which return before the sink stage)."""
+    ing = stats.get("ingest")
+    if not ing:
+        return
+    for k in ("n_threads", "n_units", "triples_per_sec", "bytes_per_sec",
+              "queue_stalls"):
+        if k in ing:
+            counters[f"ingest-{k.replace('_', '-')}"] = ing[k]
+
+
 def _emit_sinks(cfg: Config, phases: _Phases, counters: dict, table,
                 dictionary, stats: dict, ids) -> None:
     """Debug reports + every result sink; shared by the replicated and the
     sharded-ingest paths so they can never diverge."""
+    if cfg.debug_level >= 1 and "ingest" in stats and _is_primary():
+        # Parallel-ingest telemetry: phase split (worker phases are sums
+        # across threads), throughput, and the consumer-side stall count
+        # (how often the in-order block delivery had to wait on a unit).
+        ing = stats["ingest"]
+        print(f"ingest: threads={ing.get('n_threads')} "
+              f"units={ing.get('n_units')} files={ing.get('n_files')} "
+              f"bytes={ing.get('bytes_read')} "
+              f"read_ms={ing.get('read_ms')} parse_ms={ing.get('parse_ms')} "
+              f"intern_ms={ing.get('intern_ms')} "
+              f"merge_ms={ing.get('merge_ms')} remap_ms={ing.get('remap_ms')} "
+              f"stalls={ing.get('queue_stalls')} "
+              f"triples/s={ing.get('triples_per_sec')} "
+              f"bytes/s={ing.get('bytes_per_sec')}", file=sys.stderr)
+
+    if cfg.debug_level >= 1 and stats.get("exchange_sites") and _is_primary():
+        # Per-exchange communication ledger (parallel/exchange.log_exchange):
+        # fixed-shape collective volume per site, the input to multi-chip
+        # bandwidth projections.
+        for site, e in sorted(stats["exchange_sites"].items()):
+            print(f"exchange[{site}]: calls={e['calls']} "
+                  f"capacity={e['capacity']} lanes={e['lanes']} "
+                  f"bytes={e['bytes']} rows_capacity={e['rows_capacity']} "
+                  f"overflow_retries={e['overflow_retries']}",
+                  file=sys.stderr)
     if cfg.debug_level >= 1 and len(table) and _is_primary():
         # Per-family CIND counts (TraversalStrategy.scala:101-107).
         fams = table.family_counts()

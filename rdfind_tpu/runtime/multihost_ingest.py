@@ -43,17 +43,22 @@ def native_parse_eligible(use_native: bool, transform, encoding) -> bool:
 
 
 def _local_ingest(paths, tabs: bool, expect_quad: bool, encoding,
-                  use_native: bool = True, transform=None):
+                  use_native: bool = True, transform=None, stats=None):
     """This host's file subset -> (local (N,3) int32 ids, local Dictionary).
 
     `transform(token) -> token` applies per-token string preprocessing
     (asciify, URL shortening) before interning — token-local, so each host
     runs it independently on its own shard; it forces the Python parse path.
+    `stats`, when a dict, receives the ingest telemetry (io/native.py lanes
+    on the native path; a reduced set on the Python fallback).
     """
     if not paths:
         return np.zeros((0, 3), np.int32), Dictionary(np.zeros(0, object))
     if native_parse_eligible(use_native, transform, encoding):
-        return native.ingest_files(paths, tabs=tabs, expect_quad=expect_quad)
+        if native.ingest_threads() > 1:
+            return _local_ingest_streamed(paths, tabs, expect_quad, stats)
+        return native.ingest_files(paths, tabs=tabs, expect_quad=expect_quad,
+                                   stats=stats)
     from ..dictionary import intern_triples
 
     rows = []
@@ -65,7 +70,40 @@ def _local_ingest(paths, tabs: bool, expect_quad: bool, encoding,
                 transform(v) for v in t))
     if not rows:
         return np.zeros((0, 3), np.int32), Dictionary(np.zeros(0, object))
-    return intern_triples(np.asarray(rows, dtype=object))
+    out = intern_triples(np.asarray(rows, dtype=object))
+    if stats is not None:
+        stats.update(n_threads=1, triples=int(out[0].shape[0]),
+                     values=len(out[1]), parser="python")
+    return out
+
+
+def _local_ingest_streamed(paths, tabs: bool, expect_quad: bool, stats=None):
+    """Streamed native ingest: committed triple blocks land in this host's
+    staging table WHILE later files/chunks still parse (the PR-1
+    compute/readback overlap shape, applied to the pipeline's front door).
+    The per-thread provisional ids are rewritten to the byte-sorted local
+    ranks at finish, so the result is bit-identical to the serial engine and
+    the downstream interning collectives see exactly the dictionary they
+    always did."""
+    import time
+
+    t_wall = time.perf_counter()
+    with native.IngestStream(paths, tabs=tabs,
+                             expect_quad=expect_quad) as stream:
+        asm = native.BlockAssembler()
+        for block, thread_id in stream:
+            asm.add(block, thread_id)  # handoff overlaps the ongoing parse
+        remaps = stream.finish()
+        t0 = time.perf_counter()
+        ids = asm.finalize(remaps)
+        remap_ms = (time.perf_counter() - t0) * 1000.0
+        values, lossless = stream.decoded_values()
+        st = stream.stats()
+    ids, dictionary = native.canonicalize(ids, values, lossless)
+    if stats is not None:
+        st["remap_ms"] += remap_ms
+        native.publish_stats(stats, st, ids.shape[0], len(dictionary), t_wall)
+    return ids, dictionary
 
 
 def _allgather_str_arrays(local_values) -> list[np.ndarray]:
@@ -120,12 +158,13 @@ def _allgather_values(local_values: np.ndarray) -> np.ndarray:
 
 
 def _value_owner(values, num_hosts: int) -> np.ndarray:
-    """Deterministic owner host per value (crc32 — identical on every host)."""
-    import zlib
+    """Deterministic owner host per value (dictionary.value_shard — the one
+    crc32 partition shared with the native parallel-merge shards, so every
+    layer that splits a dictionary agrees; identical on every host)."""
+    from ..dictionary import value_shard
 
-    return np.fromiter(
-        (zlib.crc32(str(v).encode("utf-8")) % num_hosts for v in values),
-        np.int64, count=len(values))
+    return np.fromiter((value_shard(v, num_hosts) for v in values),
+                       np.int64, count=len(values))
 
 
 @dataclasses.dataclass
@@ -265,7 +304,7 @@ def sharded_ingest(paths: list[str], mesh, *, tabs: bool = False,
                    use_native: bool = True,
                    partition_dictionary: bool | None = None,
                    transform=None, cache=None, cache_fp: str = "",
-                   cache_hit=None):
+                   cache_hit=None, stats: dict | None = None):
     """Multi-host ingest over `mesh`.
 
     Returns (global_triples, global_n_valid, dictionary, total_triples):
@@ -304,9 +343,13 @@ def sharded_ingest(paths: list[str], mesh, *, tabs: bool = False,
         if cache_hit is not None:
             cache_hit.append(stored is not None)
     if local_ids is None:
+        ingest_stats: dict = {}
         local_ids, local_dict = _local_ingest(my_paths, tabs, expect_quad,
                                               encoding, use_native,
-                                              transform=transform)
+                                              transform=transform,
+                                              stats=ingest_stats)
+        if stats is not None and ingest_stats:
+            stats["ingest"] = ingest_stats
         if cache is not None:
             cache.save(stage, cache_fp,
                        ckpt_mod.encode_ingest(local_ids, local_dict))

@@ -8,9 +8,15 @@ import os
 import subprocess
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# RDFIND_TEST_TPU=1 lifts the CPU pin so the `-m tpu` tier (on-chip Pallas
+# parity + end-to-end golden, tests/test_tpu_tier.py) can reach the real
+# backend; everything below down to the final config.update is gated on it.
+_FORCE_CPU = not os.environ.get("RDFIND_TEST_TPU")
+
+if _FORCE_CPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if _FORCE_CPU and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 

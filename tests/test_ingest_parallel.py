@@ -1,0 +1,272 @@
+"""Parallel native ingest vs the serial engine: bit-identical, on purpose.
+
+The determinism contract (native/rdfind_native.cpp header): final ids are
+byte-sorted ranks of the global distinct set and triples keep input order, so
+WHICH thread parses a unit is free to vary while the output cannot.  These
+tests sweep thread counts and chunk sizes over a mixed workload (multi-file,
+gz + plain, comments, CRLF, files larger than the chunk size) and pin the
+parallel engine to the serial one AND to the pure-Python reference parser.
+"""
+
+import gzip
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from rdfind_tpu.dictionary import intern_triples
+from rdfind_tpu.io import native, ntriples, reader
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def python_path(paths, tabs=False, expect_quad=False):
+    rows = []
+    for _, line in reader.iter_lines(paths):
+        t = (ntriples.parse_tab_line(line) if tabs
+             else ntriples.parse_line(line, expect_quad=expect_quad))
+        if t is not None:
+            rows.append(t)
+    return intern_triples(np.asarray(rows, dtype=object))
+
+
+def assert_same(got, want):
+    ids_n, d_n = got
+    ids_p, d_p = want
+    np.testing.assert_array_equal(ids_n, ids_p)
+    assert list(d_n.values) == list(d_p.values)
+
+
+@pytest.fixture(scope="module")
+def mixed_workload(tmp_path_factory):
+    """Multi-file workload exercising every chunking rule at once: a plain
+    file much larger than the test chunk size, a CRLF file without a
+    trailing newline, comments and blank lines, and a gz member."""
+    td = tmp_path_factory.mktemp("ingest")
+    rng = np.random.default_rng(3)
+    paths = []
+
+    big = td / "big.nt"
+    lines = []
+    for i in range(4000):
+        s = f"<http://ex/s{rng.integers(700)}>"
+        p = f"<http://ex/p{rng.integers(13)}>"
+        kind = rng.integers(3)
+        if kind == 0:
+            o = f"<http://ex/o{rng.integers(400)}>"
+        elif kind == 1:
+            o = f'"value {rng.integers(250)} with spaces"'
+        else:
+            o = f"_:b{rng.integers(60)}"
+        lines.append(f"{s} {p} {o} .")
+        if i % 97 == 0:
+            lines.append("# interleaved comment")
+        if i % 131 == 0:
+            lines.append("")
+    big.write_text("\n".join(lines) + "\n")
+    paths.append(str(big))
+
+    crlf = td / "crlf.nt"
+    crlf.write_bytes(b"# leading comment\r\n"
+                     b"<s> <p> <o1> .\r\n"
+                     b"<s> <p> \"lit with \\\" escape\"@en .\r\n"
+                     b"<s> <p> <o2> .")  # no trailing newline
+    paths.append(str(crlf))
+
+    gz = td / "tail.nt.gz"
+    with gzip.open(gz, "wt") as g:
+        for i in range(700):
+            g.write(f"<http://ex/g{i % 41}> <http://ex/p1> \"gz {i % 29}\" .\n")
+    paths.append(str(gz))
+    return paths
+
+
+@pytest.mark.parametrize("threads,chunk_bytes", [
+    (2, 1 << 12), (4, 1 << 12), (4, 997), (8, 1 << 30)])
+def test_parallel_serial_python_differential(mixed_workload, threads,
+                                             chunk_bytes):
+    serial = native.ingest_files(mixed_workload, threads=1)
+    par = native.ingest_files(mixed_workload, threads=threads,
+                              chunk_bytes=chunk_bytes)
+    assert_same(par, serial)
+    assert_same(par, python_path(mixed_workload))
+
+
+def test_env_thread_knob_and_stats(mixed_workload, monkeypatch):
+    monkeypatch.setenv("RDFIND_INGEST_THREADS", "3")
+    monkeypatch.setenv("RDFIND_INGEST_CHUNK_BYTES", str(1 << 13))
+    stats: dict = {}
+    got = native.ingest_files(mixed_workload, stats=stats)
+    assert stats["n_threads"] == 3
+    assert stats["n_units"] > len(mixed_workload)  # big.nt got chunk-split
+    assert stats["n_files"] == len(mixed_workload)
+    for k in ("bytes_read", "read_ms", "parse_ms", "intern_ms", "merge_ms",
+              "remap_ms", "queue_stalls", "queue_stall_ms", "wall_ms",
+              "triples", "values", "triples_per_sec", "bytes_per_sec"):
+        assert k in stats, k
+    assert stats["bytes_read"] > 0 and stats["triples_per_sec"] > 0
+    monkeypatch.delenv("RDFIND_INGEST_THREADS")
+    monkeypatch.delenv("RDFIND_INGEST_CHUNK_BYTES")
+    assert_same(got, native.ingest_files(mixed_workload, threads=1))
+
+
+def test_serial_engine_also_reports_stats(mixed_workload):
+    stats: dict = {}
+    native.ingest_files(mixed_workload, threads=1, stats=stats)
+    assert stats["n_threads"] == 1
+    assert stats["triples"] > 0 and stats["bytes_read"] > 0
+
+
+def test_chunk_boundary_sweep(tmp_path):
+    """Every byte offset of a CRLF/LF-mixed file serves as a chunk boundary
+    somewhere in this sweep — lines must never duplicate or vanish."""
+    f = tmp_path / "b.nt"
+    f.write_bytes(b"<s1> <p> <o1> .\r\n"
+                  b"<s2> <p> <o2> .\n"
+                  b"# comment\r\n"
+                  b"<s3> <p> <o3> .\r\n"
+                  b"<s4> <p> <o4> .")
+    want = native.ingest_files([str(f)], threads=1)
+    for chunk in range(5, 40):
+        got = native.ingest_files([str(f)], threads=4, chunk_bytes=chunk)
+        assert_same(got, want)
+
+
+def test_stream_blocks_preserve_input_order(mixed_workload):
+    """Raw streamed blocks concatenate to the serial triple order after the
+    per-thread remap — the contract multihost staging relies on."""
+    ids_serial, d_serial = native.ingest_files(mixed_workload, threads=1)
+    with native.IngestStream(mixed_workload, threads=4,
+                             chunk_bytes=1 << 12) as stream:
+        blocks = [(b, t) for b, t in stream]
+        remaps = stream.finish()
+        values, lossless = stream.decoded_values()
+    assert len(blocks) > len(mixed_workload)  # chunk-split streamed blocks
+    out = [remaps[t][b] for b, t in blocks if b.size]
+    ids = np.concatenate(out)
+    ids, d = native.canonicalize(ids, values, lossless)
+    np.testing.assert_array_equal(ids, ids_serial)
+    assert list(d.values) == list(d_serial.values)
+
+
+def test_parallel_parse_error_surface(tmp_path):
+    ok = tmp_path / "ok.nt"
+    ok.write_text("<s> <p> <o> .\n" * 50)
+    bad = tmp_path / "bad.nt"
+    bad.write_text("<s> <p> <o> .\n" * 20 + "<s> <p>\n" + "<s> <p> <o> .\n")
+    with pytest.raises(native.NativeIngestError, match="expected 3 terms"):
+        native.ingest_files([str(ok), str(bad)], threads=4,
+                            chunk_bytes=1 << 8)
+    with pytest.raises(native.NativeIngestError, match="unterminated"):
+        bad.write_text('<s> <p> "never closed .\n')
+        native.ingest_files([str(ok), str(bad)], threads=4)
+
+
+def test_parallel_tabs_and_quads(tmp_path):
+    tsv = tmp_path / "a.tsv"
+    tsv.write_text("".join(f"s{i % 7}\tp{i % 3}\to{i % 11}\n"
+                           for i in range(500)))
+    assert_same(native.ingest_files([str(tsv)], tabs=True, threads=4,
+                                    chunk_bytes=1 << 8),
+                native.ingest_files([str(tsv)], tabs=True, threads=1))
+    nq = tmp_path / "a.nq"
+    nq.write_text("".join(
+        f"<http://ex/s{i % 5}> <http://ex/p> <http://ex/o{i % 9}> "
+        f"<http://ex/g{i % 2}> .\n" for i in range(300)))
+    assert_same(native.ingest_files([str(nq)], expect_quad=True, threads=4,
+                                    chunk_bytes=1 << 8),
+                native.ingest_files([str(nq)], expect_quad=True, threads=1))
+
+
+def test_parallel_invalid_utf8_recanonicalized(tmp_path):
+    """The invalid-UTF-8 np.unique re-canonicalization applies on the
+    parallel path too (same fixture as the serial splice test)."""
+    f = tmp_path / "splice.tsv"
+    f.write_bytes(b"a\xc3\tz1\tZ\n\xa9b\tz2\tZ\na\xc3\tz3\tZ\n")
+    got = native.ingest_files([str(f)], tabs=True, threads=3)
+    want = native.ingest_files([str(f)], tabs=True, threads=1)
+    assert_same(got, want)
+    assert len(set(got[1].values)) == len(got[1].values)
+
+
+def test_multihost_local_ingest_streamed_matches(mixed_workload, monkeypatch):
+    """The streamed handoff path in runtime/multihost_ingest produces the
+    same local parse as a direct ingest_files call, telemetry included."""
+    from rdfind_tpu.runtime import multihost_ingest
+
+    monkeypatch.setenv("RDFIND_INGEST_THREADS", "4")
+    stats: dict = {}
+    ids, d = multihost_ingest._local_ingest(
+        mixed_workload, tabs=False, expect_quad=False, encoding="utf-8",
+        stats=stats)
+    assert stats["n_threads"] == 4
+    assert stats["triples"] == ids.shape[0]
+    assert_same((ids, d), native.ingest_files(mixed_workload, threads=1))
+
+
+def test_block_assembler_growth():
+    asm = native.BlockAssembler()
+    rng = np.random.default_rng(0)
+    want = []
+    for i in range(40):
+        b = rng.integers(0, 5, (rng.integers(0, 4000), 3)).astype(np.int32)
+        asm.add(b, i % 3)
+        want.append(b.copy())
+    remaps = [np.arange(5, dtype=np.int32) * (t + 1) for t in range(3)]
+    got = asm.finalize(remaps)
+    expect = np.concatenate([remaps[i % 3][b] for i, b in enumerate(want)
+                             if b.size] or [np.zeros((0, 3), np.int32)])
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_value_shard_matches_native_partition():
+    """dictionary.value_shard is THE partition function: the native merge
+    uses crc32 % S over raw bytes, which must agree for valid UTF-8."""
+    import zlib
+
+    from rdfind_tpu.dictionary import value_shard
+
+    for v in ("<http://ex/a>", "\"lit\"@en", "_:b1", "ünïcode"):
+        for s in (2, 3, 8):
+            assert value_shard(v, s) == zlib.crc32(v.encode()) % s
+
+
+@pytest.mark.slow
+def test_pthread_build_and_differential_smoke(tmp_path):
+    """Builds native/ from source with the -pthread Makefile into a scratch
+    .so, then runs the threads=1 vs threads=4 differential end-to-end in a
+    subprocess bound to the fresh library (RDFIND_NATIVE_SO)."""
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")
+    build = tmp_path / "native"
+    shutil.copytree(src, build)
+    so = tmp_path / "fresh.so"
+    proc = subprocess.run(["make", "-C", str(build), f"TARGET={so}"],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert so.exists()
+
+    data = tmp_path / "w.nt"
+    data.write_text("".join(
+        f"<http://ex/s{i % 91}> <http://ex/p{i % 7}> \"v{i % 53}\" .\n"
+        for i in range(20_000)))
+    code = (
+        "import numpy as np\n"
+        "from rdfind_tpu.io import native\n"
+        f"paths = [{str(data)!r}]\n"
+        "a = native.ingest_files(paths, threads=1)\n"
+        "b = native.ingest_files(paths, threads=4, chunk_bytes=1 << 14)\n"
+        "assert np.array_equal(a[0], b[0])\n"
+        "assert list(a[1].values) == list(b[1].values)\n"
+        "print('DIFFERENTIAL_OK', a[0].shape[0])\n")
+    env = {**os.environ, "RDFIND_NATIVE_SO": str(so),
+           "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=300,
+                          cwd=os.path.dirname(src))
+    assert proc.returncode == 0, proc.stderr
+    assert "DIFFERENTIAL_OK 20000" in proc.stdout
