@@ -25,6 +25,10 @@ import traceback
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from rdfind_tpu import obs  # noqa: E402
+from rdfind_tpu.obs import report as obs_report  # noqa: E402
+
 
 def _probe_tpu_subprocess(timeout_s: int) -> tuple[bool, str]:
     """Probe the default (TPU) backend in a subprocess with a hard timeout.
@@ -228,15 +232,13 @@ def _bench_pipelined_passes(min_support: int) -> dict:
                                                     mesh=mesh, stats=stats)
             rows[mode] = {
                 "wall_s": round(time.perf_counter() - t0, 3),
-                **{k: stats.get(k) for k in (
-                    "n_pair_passes", "n_passes_in_flight", "n_host_syncs",
-                    "host_sync_ms", "pull_overlap_ms", "n_pair_cap_retries",
-                    "cap_p_final",
-                    # Fault-domain telemetry (PR 3): ladder + retry/backoff
-                    # counters prove a degraded run degraded, and a clean one
-                    # didn't, straight from the artifact.
-                    "n_overflow_retries", "n_host_pull_retries",
-                    "backoff_ms_total")},
+                # Dispatch + fault telemetry via the shared obs key groups
+                # (obs/metrics.DISPATCH_KEYS/FAULT_KEYS): bench rows, the
+                # driver's --debug lines and the tests render the same
+                # names by construction.  The ladder + retry counters prove
+                # a degraded run degraded, and a clean one didn't, straight
+                # from the artifact.
+                **obs_report.dispatch_row(stats),
                 "degradations": stats.get("degradations"),
                 "ladder_rung": stats.get("ladder_rung"),
                 "cinds": len(tables[mode]),
@@ -447,6 +449,16 @@ def _run(n: int, min_support: int) -> dict:
     except Exception as e:
         detail["ingest"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # Unified obs snapshot (ISSUE 5): the metrics-registry mirror of every
+    # stats key the process published (dispatch + exchange + ingest + fault
+    # telemetry, accumulated across the rows above) plus the current device
+    # memory watermarks — ONE schema for every BENCH_* artifact going
+    # forward.
+    try:
+        detail["obs"] = obs.snapshot()
+    except Exception as e:
+        detail["obs"] = {"error": f"{type(e).__name__}: {e}"}
+
     # Pallas packed-bitset kernel vs jnp planes path, on this backend.
     try:
         from rdfind_tpu.ops import sketch
@@ -510,7 +522,7 @@ def main():
                 "metric": "ingest_triples_per_sec",
                 "value": value, "unit": "triples/s",
                 "vs_baseline": round(value / max(base, 1e-9), 3),
-                "detail": {"ingest": ing},
+                "detail": {"ingest": ing, "obs": obs.snapshot()},
             }
         except Exception as e:
             result = {"metric": "ingest_triples_per_sec", "value": 0,

@@ -37,6 +37,7 @@ import numpy as np
 
 from .. import conditions as cc
 from ..data import CindTable
+from ..obs import metrics
 from ..ops import cooc, frequency, minimality, pairs, segments
 from ..ops.emission import emit_join_candidates
 
@@ -324,8 +325,9 @@ def prepare_join_lines(triples, min_support, projections,
         dep_count=np.asarray(dep_count_d)[:num_caps].astype(np.int64),
         num_caps=num_caps)
     if stats is not None:
-        stats.update(n_triples=n, n_line_rows=n_rows, n_frequent_rows=n_keep,
-                     n_captures=num_caps, total_pairs=0)
+        metrics.set_many(stats, n_triples=n, n_line_rows=n_rows,
+                         n_frequent_rows=n_keep, n_captures=num_caps,
+                         total_pairs=0)
     return state
 
 
@@ -367,8 +369,8 @@ def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
         return None
     l_pad, c_pad, tile = plan.l_pad, plan.c_pad, plan.tile
     if stats is not None:
-        stats["dense_plan"] = plan.describe()
-        stats["cooc_dtype"] = plan.dtype
+        metrics.struct_set(stats, "dense_plan", plan.describe())
+        metrics.gauge_set(stats, "cooc_dtype", plan.dtype)
 
     if c_pad <= SINGLE_SHOT_C:
         packed, dep_count, lens, n_bits = _stage_dense_all(
@@ -414,12 +416,13 @@ def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
         # Stat semantics match the chunked backend: n_lines counts lines that
         # kept >= 1 frequent capture, n_line_rows the deduped (value, capture)
         # rows (= total memberships, the column-sum total of M).
-        stats.update(n_triples=n, n_frequent_rows=int(lens_h.sum()),
-                     n_line_rows=int(np.asarray(dep_count_h, np.int64).sum()),
-                     n_lines=int((lens_h > 0).sum()), n_captures=num_caps,
-                     total_pairs=total_pairs,
-                     max_line=int(lens_h.max()) if n_lines else 0,
-                     pair_backend="matmul")
+        metrics.set_many(
+            stats, n_triples=n, n_frequent_rows=int(lens_h.sum()),
+            n_line_rows=int(np.asarray(dep_count_h, np.int64).sum()),
+            n_lines=int((lens_h > 0).sum()), n_captures=num_caps,
+            total_pairs=total_pairs,
+            max_line=int(lens_h.max()) if n_lines else 0,
+            pair_backend="matmul")
     if dep_id.size == 0:
         return CindTable.empty()
     table = CindTable(
@@ -439,7 +442,7 @@ def _postprocess(table, triples, min_support, use_ars, clean_implied, stats):
     if use_ars:
         rules = frequency.mine_association_rules(triples, min_support)
         if stats is not None:
-            stats["association_rules"] = rules
+            metrics.struct_set(stats, "association_rules", rules)
         table = filter_ar_implied_cinds(table, rules)
     if clean_implied:
         table = minimality.minimize_table(table)
@@ -534,8 +537,8 @@ def discover(triples, min_support: int, projections: str = "spo",
     line_lens = np.diff(np.append(line_start_rows, n_keep)).astype(np.int64)
     pairs_per_line = line_lens * (line_lens - 1)
     if stats is not None:
-        stats.update(
-            n_triples=n, n_line_rows=n_rows, n_frequent_rows=n_keep,
+        metrics.set_many(
+            stats, n_triples=n, n_line_rows=n_rows, n_frequent_rows=n_keep,
             n_lines=int(line_lens.shape[0]), n_captures=int(num_caps),
             total_pairs=int(pairs_per_line.sum()),
             max_line=int(line_lens.max()) if line_lens.size else 0)
@@ -544,7 +547,7 @@ def discover(triples, min_support: int, projections: str = "spo",
 
     num_caps = int(num_caps)
     if stats is not None:
-        stats["pair_backend"] = "chunked"
+        metrics.gauge_set(stats, "pair_backend", "chunked")
     pos_h = (np.arange(n_keep, dtype=np.int64)
              - np.repeat(line_start_rows, line_lens)).astype(np.int32)
     len_h = np.repeat(line_lens, line_lens).astype(np.int32)

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from ..data import CindTable
+from ..obs import metrics
 from ..ops import cooc as cooc_ops
 from ..ops import frequency, minimality, segments, sketch
 from ..runtime import dispatch
@@ -206,10 +207,10 @@ def _dense_verify_counts(line_val_h, line_cap_h, num_caps, cand_dep, cand_ref,
     if stats is not None:
         lens = np.diff(np.append(np.flatnonzero(starts), n)).astype(np.int64)
         tot = int((lens * (lens - 1)).sum())
-        stats[stat_key] = stats.get(stat_key, 0) + tot
-        stats["total_pairs"] = stats.get("total_pairs", 0) + tot
-        stats["dense_plan"] = plan.describe()
-        stats["cooc_dtype"] = plan.dtype
+        metrics.counter_add(stats, stat_key, tot)
+        metrics.counter_add(stats, "total_pairs", tot)
+        metrics.struct_set(stats, "dense_plan", plan.describe())
+        metrics.gauge_set(stats, "cooc_dtype", plan.dtype)
 
     row_cap = segments.pow2_capacity(n)
     pad = allatonce._pad_np
@@ -268,9 +269,10 @@ def _record_backend(stats, stat_key, backend):
     multi-round strategy's rounds land on different backends)."""
     if stats is None:
         return
-    stats[stat_key + "_backend"] = backend
+    metrics.gauge_set(stats, stat_key + "_backend", backend)
     prev = stats.get("pair_backend")
-    stats["pair_backend"] = backend if prev in (None, backend) else "mixed"
+    metrics.gauge_set(stats, "pair_backend",
+                      backend if prev in (None, backend) else "mixed")
 
 
 def verify_candidates(st, cand_dep, cand_ref, min_support, *, pair_backend,
@@ -359,7 +361,7 @@ def discover(triples, min_support: int, projections: str = "spo",
                                           num_hashes=sketch_hashes,
                                           dep_mask=frequent, ref_mask=frequent)
     if stats is not None:
-        stats["n_sketch_candidates"] = len(cand_dep)
+        metrics.gauge_set(stats, "n_sketch_candidates", len(cand_dep))
     # The sketch matrix is dead past candidate generation; drop the reference
     # so its HBM is free for round 2's membership matrix.
     del sketches
@@ -377,7 +379,7 @@ def discover(triples, min_support: int, projections: str = "spo",
     if use_ars:
         rules = frequency.mine_association_rules(st["triples"], min_support)
         if stats is not None:
-            stats["association_rules"] = rules
+            metrics.struct_set(stats, "association_rules", rules)
         table = allatonce.filter_ar_implied_cinds(table, rules)
     if clean_implied:
         table = minimality.minimize_table(table)

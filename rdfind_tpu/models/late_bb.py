@@ -27,6 +27,7 @@ import numpy as np
 
 from .. import conditions as cc
 from ..data import CindTable
+from ..obs import metrics
 from ..ops import frequency, minimality, sketch
 from . import allatonce, approximate, small_to_large
 
@@ -80,7 +81,8 @@ def discover(triples, min_support: int, projections: str = "spo",
         pair_chunk_budget=pair_chunk_budget, stats=stats,
         stat_key="pairs_round1")
     if stats is not None:
-        stats.update(n_round1_candidates=len(c1_dep), n_round1_cinds=len(d1))
+        metrics.set_many(stats, n_round1_candidates=len(c1_dep),
+                         n_round1_cinds=len(d1))
 
     # Round 2: binary dependents, candidates pruned by round-1 CINDs — a
     # candidate (d1^d2, r) with a known value-matching (d1, r) CIND is implied
@@ -94,7 +96,8 @@ def discover(triples, min_support: int, projections: str = "spo",
         pair_chunk_budget=pair_chunk_budget, stats=stats,
         stat_key="pairs_round2")
     if stats is not None:
-        stats.update(n_round2_candidates=len(c2_dep), n_round2_cinds=len(d2))
+        metrics.set_many(stats, n_round2_candidates=len(c2_dep),
+                         n_round2_cinds=len(d2))
 
     all_d = np.concatenate([d1, d2])
     all_r = np.concatenate([r1, r2])
@@ -106,7 +109,7 @@ def discover(triples, min_support: int, projections: str = "spo",
     if use_ars:
         rules = frequency.mine_association_rules(st["triples"], min_support)
         if stats is not None:
-            stats["association_rules"] = rules
+            metrics.struct_set(stats, "association_rules", rules)
         table = allatonce.filter_ar_implied_cinds(table, rules)
     if clean_implied:
         table = minimality.minimize_table(table)

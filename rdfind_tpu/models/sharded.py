@@ -82,6 +82,8 @@ from .. import conditions as cc
 from ..data import CindTable
 from ..ops import frequency, hashing, minimality, pairs, segments
 from ..ops.emission import emit_join_candidates
+from ..obs import memory as obs_memory
+from ..obs import metrics, tracer
 from ..parallel import exchange
 from ..parallel.mesh import (AXIS, host_gather, host_gather_many, make_global,
                              make_mesh, shard_map)
@@ -825,7 +827,7 @@ class _Pipeline:
                                // max(self.num_dev, 1), floor=1 << 10)
         self._check_pair_caps()
         if stats is not None:
-            stats["n_pair_passes"] = self.n_pass
+            metrics.gauge_set(stats, "n_pair_passes", self.n_pass)
 
         # P2b: load-aware placement of the measured hot tail.
         self._maybe_rebalance()
@@ -857,12 +859,14 @@ class _Pipeline:
             pairs=self.cap_p, exchange_c=self.cap_c, giant_rows=self.cap_g,
             giant_pairs=self.cap_gp)
         if stats is not None:
-            stats["planned_caps"] = dict(self._planned_caps)
+            metrics.struct_set(stats, "planned_caps",
+                               dict(self._planned_caps))
             # The sketch/containment stages (sharded strategies 2/3) contract
             # in the resolved cooc dtype; record it for bench/debug parity
             # with the single-chip strategies.
             from ..ops import cooc as cooc_ops
-            stats["cooc_dtype"] = cooc_ops.resolved_cooc_dtype()
+            metrics.gauge_set(stats, "cooc_dtype",
+                              cooc_ops.resolved_cooc_dtype())
 
     def _maybe_rebalance(self):
         """Greedy least-loaded reassignment of hot lines (the reference's
@@ -897,14 +901,13 @@ class _Pipeline:
             bins[d] += loads[k]
         if self.stats is not None:
             mean = max(cur.mean(), 1.0)
-            self.stats["rebalance"] = dict(
+            metrics.struct_set(self.stats, "rebalance", dict(
                 hot_lines=int(len(jvs)),
                 moved_lines=int((dest != src).sum()),
                 load_max_over_mean_before=round(cur.max() / mean, 3),
-                load_max_over_mean_planned=round(bins.max() / mean, 3))
+                load_max_over_mean_planned=round(bins.max() / mean, 3)))
         if bins.max() >= cur.max() * _REBALANCE_MIN_GAIN:
-            if self.stats is not None:
-                self.stats["rebalance"]["moved_lines"] = 0
+            metrics.struct_update(self.stats, "rebalance", moved_lines=0)
             return  # hash placement is already close enough to balanced
         moving = dest != src
         if not moving.any():
@@ -947,8 +950,7 @@ class _Pipeline:
                     f"retries ({ovf})")
             faults.record_degradation(self.stats, "rebalance", "skip",
                                       overflow=int(ovf))
-            if self.stats is not None:
-                self.stats["rebalance"]["moved_lines"] = 0
+            metrics.struct_update(self.stats, "rebalance", moved_lines=0)
             return
         self.lines = cols
         self.n_rows = n_rows
@@ -956,8 +958,7 @@ class _Pipeline:
     def _count_overflow_retry(self, phase: str, site: str | None = None) -> None:
         """Ledger + telemetry for one capacity-grow retry (ladder rung 0)."""
         if self.stats is not None:
-            self.stats["n_overflow_retries"] = (
-                self.stats.get("n_overflow_retries", 0) + 1)
+            metrics.counter_add(self.stats, "n_overflow_retries")
             if site is not None:
                 exchange.log_exchange_retry(self.stats, site)
         faults.record_degradation(self.stats, phase, "grow")
@@ -1113,7 +1114,8 @@ class _Pipeline:
                         floor=1 << 10)
                     self._check_pair_caps()
                     if self.stats is not None:
-                        self.stats["n_pair_passes"] = self.n_pass
+                        metrics.gauge_set(self.stats, "n_pair_passes",
+                                          self.n_pass)
                     continue
                 raise faults.FallbackRequired(what, e.msg) from None
 
@@ -1143,78 +1145,98 @@ class _Pipeline:
                         parts[p] = list(blocks_p)
                         teles[p] = tele_p
                 if self.stats is not None:
-                    self.stats["resumed_passes"] = (
-                        self.stats.get("resumed_passes", 0)
-                        + sum(1 for x in parts if x is not None))
+                    metrics.counter_add(
+                        self.stats, "resumed_passes",
+                        sum(1 for x in parts if x is not None))
         depth = dispatch.pass_depth()
         inflight = collections.deque()  # (p, cols, n_out, telemetry)
         p_next = 0
         while p_next < self.n_pass or inflight:
-            while p_next < self.n_pass and len(inflight) < depth:
-                if parts[p_next] is not None:  # resumed from a checkpoint
+            # One `pass` span per committed head pass; the optimistic
+            # dispatches of its successors, the control/block pulls and the
+            # exchange-ledger instants are its children in the trace.
+            head = inflight[0][0] if inflight else p_next
+            with tracer.span("pass", cat=tracer.CAT_PASS, what=what,
+                             **{"pass": head}):
+                while p_next < self.n_pass and len(inflight) < depth:
+                    if parts[p_next] is not None:  # resumed from a checkpoint
+                        p_next += 1
+                        continue
+                    with tracer.span("dispatch", cat=tracer.CAT_DISPATCH,
+                                     what=what, **{"pass": p_next}):
+                        # Every dispatched pass moves its full fixed-shape
+                        # exchange-C and giant-gather buffers — including
+                        # optimistically dispatched passes later discarded by
+                        # a rollback, so the ledger records dispatches, not
+                        # committed passes.
+                        exchange.log_exchange(self.stats, "exchange_c",
+                                              num_dev=self.num_dev,
+                                              capacity=self.cap_c,
+                                              lanes=_LANES_EXCHANGE_C)
+                        exchange.log_exchange(
+                            self.stats, "giant_gather", num_dev=self.num_dev,
+                            capacity=min(
+                                self.cap_g,
+                                self.lines[0].shape[0] // self.num_dev),
+                            lanes=_LANES_GIANT)
+                        cols, n_out, tele = step(self._pass_args(p_next))
+                        dispatch.stage_to_host([tele])
+                    inflight.append((p_next, cols, n_out, tele))
                     p_next += 1
+                if not inflight:
+                    break  # everything left was already resumed
+                d.saw_in_flight(len(inflight))
+                p, cols, n_out, tele = inflight.popleft()
+                tele_h = d.timed_pull(
+                    lambda: exchange.unpack_counters(host_gather(tele),
+                                                     _TELE_LANES,
+                                                     self.num_dev),
+                    overlapped=bool(inflight), what="pull-counters")
+                ovf = tele_h[:_N_OVF]
+                if faults.overflow_injected(f"overflow@{site}", pass_idx=p):
+                    ovf = np.maximum(np.asarray(ovf), 1)
+                if int(ovf.sum()) != 0:
+                    tries[p] += 1
+                    if tries[p] >= self.max_retries:
+                        if self.stats is not None:
+                            d.publish(self.stats)  # keep telemetry over rungs
+                        raise _PairCapsExhausted(
+                            f"{what} overflow persisted after "
+                            f"{self.max_retries} retries "
+                            f"({np.asarray(ovf).tolist()})")
+                    self._count_overflow_retry(what, site="exchange_c")
+                    inflight.clear()  # discard optimistic successors
+                    self._grow_pair_caps(ovf)
+                    d.n_cap_retries += 1
+                    p_next = p  # resume from the failed pass only
                     continue
-                # Every dispatched pass moves its full fixed-shape exchange-C
-                # and giant-gather buffers — including optimistically
-                # dispatched passes later discarded by a rollback, so the
-                # ledger records dispatches, not committed passes.
-                exchange.log_exchange(self.stats, "exchange_c",
-                                      num_dev=self.num_dev,
-                                      capacity=self.cap_c,
-                                      lanes=_LANES_EXCHANGE_C)
-                exchange.log_exchange(
-                    self.stats, "giant_gather", num_dev=self.num_dev,
-                    capacity=min(self.cap_g,
-                                 self.lines[0].shape[0] // self.num_dev),
-                    lanes=_LANES_GIANT)
-                cols, n_out, tele = step(self._pass_args(p_next))
-                dispatch.stage_to_host([tele])
-                inflight.append((p_next, cols, n_out, tele))
-                p_next += 1
-            if not inflight:
-                break  # everything left was already resumed
-            d.saw_in_flight(len(inflight))
-            p, cols, n_out, tele = inflight.popleft()
-            tele_h = d.timed_pull(
-                lambda: exchange.unpack_counters(host_gather(tele),
-                                                 _TELE_LANES, self.num_dev),
-                overlapped=bool(inflight))
-            ovf = tele_h[:_N_OVF]
-            if faults.overflow_injected(f"overflow@{site}", pass_idx=p):
-                ovf = np.maximum(np.asarray(ovf), 1)
-            if int(ovf.sum()) != 0:
-                tries[p] += 1
-                if tries[p] >= self.max_retries:
-                    if self.stats is not None:
-                        d.publish(self.stats)  # keep telemetry across rungs
-                    raise _PairCapsExhausted(
-                        f"{what} overflow persisted after {self.max_retries} "
-                        f"retries ({np.asarray(ovf).tolist()})")
-                self._count_overflow_retry(what, site="exchange_c")
-                inflight.clear()  # discard optimistically dispatched successors
-                self._grow_pair_caps(ovf)
-                d.n_cap_retries += 1
-                p_next = p  # resume from the failed pass only
-                continue
-            parts[p] = d.timed_pull(lambda: self.collect_blocks(cols, n_out),
-                                    overlapped=bool(inflight))
-            teles[p] = tuple(int(x) for x in tele_h[_N_OVF:])
-            if progress is not None:
-                # Cumulative snapshot of every committed pass, written by a
-                # worker thread (atomic + fsynced) while successors compute.
-                progress.submit(stage, fp, {
-                    i: (parts[i], teles[i]) for i in range(self.n_pass)
-                    if parts[i] is not None})
-            if faults.fires("preempt@discover", pass_idx=p):
+                parts[p] = d.timed_pull(
+                    lambda: self.collect_blocks(cols, n_out),
+                    overlapped=bool(inflight), what="pull-blocks")
+                teles[p] = tuple(int(x) for x in tele_h[_N_OVF:])
+                if tracer.enabled() or metrics.export_requested():
+                    # Per-pass HBM watermark + allocation delta (near-cap
+                    # warnings fire BEFORE the ladder has to) — sampled only
+                    # with a live obs consumer so the disabled path stays
+                    # free of per-pass host work.
+                    obs_memory.sample(self.stats, label=f"{what} pass {p}")
                 if progress is not None:
-                    progress.flush()  # the SIGTERM handler's analog
-                raise faults.Preempted(
-                    f"injected preemption after {what} pass {p}")
+                    # Cumulative snapshot of every committed pass, written by
+                    # a worker thread (atomic + fsynced) while successors
+                    # compute.
+                    progress.submit(stage, fp, {
+                        i: (parts[i], teles[i]) for i in range(self.n_pass)
+                        if parts[i] is not None})
+                if faults.fires("preempt@discover", pass_idx=p):
+                    if progress is not None:
+                        progress.flush()  # the SIGTERM handler's analog
+                    raise faults.Preempted(
+                        f"injected preemption after {what} pass {p}")
         blocks = [np.concatenate([part[i] for part in parts])
                   for i in range(len(parts[0]))]
         if self.stats is not None:
             d.publish(self.stats)
-            self.stats["cap_p_final"] = self.cap_p
+            metrics.gauge_set(self.stats, "cap_p_final", self.cap_p)
         return blocks, tuple(zip(*teles))
 
     def run_cinds(self):
@@ -1233,8 +1255,8 @@ class _Pipeline:
             # max across passes: a mid-run cap_p growth shifts the giant
             # threshold between passes, so the last pass may see fewer giants
             # than an earlier one (ADVICE r5).
-            self.stats["n_giant_lines"] = max(ngl)
-            self.stats["n_giant_pairs"] = sum(ngp)
+            metrics.gauge_set(self.stats, "n_giant_lines", max(ngl))
+            metrics.gauge_set(self.stats, "n_giant_pairs", sum(ngp))
         return blocks
 
     def run_cooc(self, fcode, fv1, fv2, fflag, n_flags, stat_key):
@@ -1255,13 +1277,10 @@ class _Pipeline:
             step, "sharded S2L cooc", site="cooc", phase_key=stat_key,
             fp_extra={"flags": digest})
         if self.stats is not None:
-            self.stats[stat_key] = sum(npt)
-            self.stats["total_pairs"] = (self.stats.get("total_pairs", 0)
-                                         + sum(npt))
-            self.stats["n_giant_lines"] = max(
-                self.stats.get("n_giant_lines", 0), max(ngl))
-            self.stats["n_giant_pairs"] = (
-                self.stats.get("n_giant_pairs", 0) + sum(ngp))
+            metrics.gauge_set(self.stats, stat_key, sum(npt))
+            metrics.counter_add(self.stats, "total_pairs", sum(npt))
+            metrics.counter_max(self.stats, "n_giant_lines", max(ngl))
+            metrics.counter_add(self.stats, "n_giant_pairs", sum(ngp))
         return blocks
 
 
@@ -1361,7 +1380,7 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
         from . import allatonce
         rules = _mine_rules(triples, preshard, min_support, mesh)
         if stats is not None:
-            stats["association_rules"] = rules
+            metrics.struct_set(stats, "association_rules", rules)
         table = allatonce.filter_ar_implied_cinds(table, rules)
     if clean_implied:
         table = minimality.minimize_table_sharded(table, mesh)
@@ -1553,7 +1572,8 @@ def _sharded_sketch_candidates(pipe, cap_table, bits, num_hashes, stats):
     # rows — pow2-bucketed under RDFIND_TILE_SCHEDULE=0 for compile reuse).
     c_pad = cooc_ops.cap_pad(num_caps, mult=128 * num_dev)
     if stats is not None:
-        stats["sketch_plan"] = {"c_real": int(num_caps), "c_pad": int(c_pad)}
+        metrics.struct_set(stats, "sketch_plan",
+                           {"c_real": int(num_caps), "c_pad": int(c_pad)})
     pad = lambda a: np.concatenate(
         [a.astype(np.int32), np.full(c_pad - num_caps, SENTINEL, np.int32)])
     packed = _sketch_step(
@@ -1564,7 +1584,7 @@ def _sharded_sketch_candidates(pipe, cap_table, bits, num_hashes, stats):
     bits_h = cooc_ops.unpack_cind_bits(host_gather(packed), c_pad)
     d, r = np.nonzero(bits_h[:num_caps, :num_caps])
     if stats is not None:
-        stats["n_sketch_candidates"] = int(d.size)
+        metrics.gauge_set(stats, "n_sketch_candidates", int(d.size))
     return d.astype(np.int64), r.astype(np.int64)
 
 
@@ -1607,8 +1627,8 @@ def _sharded_prep_approx(triples, min_support, mesh, projections, use_fis,
     if stats is not None:
         n_triples = (triples.shape[0] if preshard is None
                      else int(host_gather(preshard[1]).sum()))
-        stats.update(n_triples=n_triples,
-                     n_captures=int(cap_table[0].shape[0]), total_pairs=0)
+        metrics.set_many(stats, n_triples=n_triples,
+                         n_captures=int(cap_table[0].shape[0]), total_pairs=0)
     cand_dep, cand_ref = _sharded_sketch_candidates(
         pipe, cap_table, sketch_bits, sketch_hashes, stats)
     backend = _ShardedCooc(pipe, cap_table)
@@ -1627,7 +1647,7 @@ def _finish_table(cap_table, d, r, sup, triples, min_support, use_ars,
     if use_ars:
         rules = _mine_rules(triples, preshard, min_support, mesh)
         if stats is not None:
-            stats["association_rules"] = rules
+            metrics.struct_set(stats, "association_rules", rules)
         table = allatonce.filter_ar_implied_cinds(table, rules)
     if clean_implied:
         table = (minimality.minimize_table_sharded(table, mesh)
@@ -1732,7 +1752,8 @@ def discover_sharded_late_bb(triples, min_support: int, mesh=None,
             use_fis, use_ars, clean_implied, stats,
             sketch_bits=sketch_bits, sketch_hashes=sketch_hashes)
     if stats is not None:
-        stats.update(n_round1_cinds=len(d1), n_round2_cinds=len(d2))
+        metrics.set_many(stats, n_round1_cinds=len(d1),
+                         n_round2_cinds=len(d2))
     return _finish_table(
         cap_table, np.concatenate([d1, d2]), np.concatenate([r1, r2]),
         np.concatenate([sup1, sup2]), triples, min_support, use_ars,
@@ -1783,15 +1804,15 @@ def discover_sharded_s2l(triples, min_support: int, mesh=None,
         if stats is not None:
             n_triples = (triples.shape[0] if preshard is None
                          else int(host_gather(pipe._n_valid).sum()))
-            stats.update(n_triples=n_triples, n_captures=num_caps,
-                         total_pairs=0)
+            metrics.set_many(stats, n_triples=n_triples,
+                             n_captures=num_caps, total_pairs=0)
 
         backend = _ShardedCooc(pipe, (cap_code, cap_v1, cap_v2, dep_count))
 
         rules = (_mine_rules(triples, preshard, min_support, pipe.mesh)
                  if use_ars else None)
         if use_ars and stats is not None:
-            stats["association_rules"] = rules
+            metrics.struct_set(stats, "association_rules", rules)
 
         return small_to_large._run_lattice(
             backend.cooc, cap_code, cap_v1, cap_v2, dep_count, num_caps,

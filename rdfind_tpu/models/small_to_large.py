@@ -46,6 +46,7 @@ import numpy as np
 
 from .. import conditions as cc
 from ..data import NO_VALUE, CindTable
+from ..obs import metrics
 from ..ops import cooc as cooc_ops
 from ..ops import frequency, minimality, pairs, segments, sketch
 from ..runtime import dispatch, faults
@@ -115,9 +116,9 @@ def _iter_chunk_pairs(line_val_h, line_cap_h, dep_ok, ref_ok, budget,
     if balanced:
         pairs_per_line //= 2  # each unordered pair materializes once
     if stats is not None:
-        stats[stat_key] = stats.get(stat_key, 0) + int(pairs_per_line.sum())
-        stats["total_pairs"] = (stats.get("total_pairs", 0)
-                                + int(pairs_per_line.sum()))
+        metrics.counter_add(stats, stat_key, int(pairs_per_line.sum()))
+        metrics.counter_add(stats, "total_pairs",
+                            int(pairs_per_line.sum()))
     if int(pairs_per_line.sum()) == 0:
         return
     pos_h = (np.arange(n, dtype=np.int64)
@@ -377,10 +378,11 @@ def _half_approx_cooc_11(line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats,
     r1 = exp_keys[keep1] & 0xFFFFFFFF
     c1 = exp_cnt[keep1]
     if stats is not None:
-        stats.update(ha_spilled=n_spilled, ha_round2_deps=len(r2_deps),
-                     ha_explicit_pairs=len(exp_keys),
-                     ha_round2_merged_pairs=int(d2.size),
-                     ha_round2_rows=n_r2_rows)
+        metrics.set_many(stats, ha_spilled=n_spilled,
+                         ha_round2_deps=len(r2_deps),
+                         ha_explicit_pairs=len(exp_keys),
+                         ha_round2_merged_pairs=int(d2.size),
+                         ha_round2_rows=n_r2_rows)
     d_out = np.concatenate([d1, d2])
     r_out = np.concatenate([r1, r2])
     c_out = np.concatenate([c1, c2])
@@ -438,13 +440,14 @@ def _prepare_dense(padded, n, min_support, projections, use_fc_filter, use_ars,
          jax.lax.slice(lens, (0,), (n_lines,))))
     if stats is not None:
         lens64 = lens_h.astype(np.int64)
-        stats.update(n_triples=n, n_lines=int((lens64 > 0).sum()),
-                     n_frequent_rows=int(lens64.sum()),
-                     n_line_rows=int(dep_count.astype(np.int64).sum()),
-                     n_captures=num_caps, total_pairs=0,
-                     max_line=int(lens64.max()) if lens64.size else 0,
-                     pair_backend="matmul",
-                     dense_plan=plan.describe(), cooc_dtype=plan.dtype)
+        metrics.set_many(
+            stats, n_triples=n, n_lines=int((lens64 > 0).sum()),
+            n_frequent_rows=int(lens64.sum()),
+            n_line_rows=int(dep_count.astype(np.int64).sum()),
+            n_captures=num_caps, total_pairs=0,
+            max_line=int(lens64.max()) if lens64.size else 0,
+            pair_backend="matmul",
+            dense_plan=plan.describe(), cooc_dtype=plan.dtype)
     fn = _DenseCooc(m, cooc_m, dep_count_d, c_pad, n_lines, num_caps)
     return (fn, cap_code.astype(np.int64), cap_v1.astype(np.int64),
             cap_v2.astype(np.int64), dep_count.astype(np.int64), num_caps)
@@ -611,8 +614,8 @@ def _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
             if n_cand is not None and n_cand == 0:
                 continue
             n_pairs = int((u * (u - 1)).sum())
-            stats[key] = n_pairs
-            stats["total_pairs"] = stats.get("total_pairs", 0) + n_pairs
+            metrics.gauge_set(stats, key, n_pairs)
+            metrics.counter_add(stats, "total_pairs", n_pairs)
         return tuple(it)
 
     # --- 1/1.
@@ -650,10 +653,10 @@ def _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
             ref_v1=cap_v1[cind11_r], ref_v2=cap_v2[cind11_r],
             support=dep_count[cind11_d])
         if stats is not None:
-            stats.update(n_cinds_11=len(cind11_d),
-                         n_proper_overlaps=int(extras[0]),
-                         n_cinds_12=0, n_cinds_21=0, n_inferred_21=0,
-                         n_cinds_22=0)
+            metrics.set_many(stats, n_cinds_11=len(cind11_d),
+                             n_proper_overlaps=int(extras[0]),
+                             n_cinds_12=0, n_cinds_21=0, n_inferred_21=0,
+                             n_cinds_22=0)
         if clean_implied:
             table = minimality.minimize_table(table)
         return table
@@ -723,10 +726,11 @@ def _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
     extras = flush_stats((n_prop, n_inf))
 
     if stats is not None:
-        stats.update(n_cinds_11=len(cind11_d),
-                     n_proper_overlaps=int(extras[0]),
-                     n_cinds_12=len(d12), n_cinds_21=len(d21),
-                     n_inferred_21=int(extras[1]), n_cinds_22=len(d22))
+        metrics.set_many(stats, n_cinds_11=len(cind11_d),
+                         n_proper_overlaps=int(extras[0]),
+                         n_cinds_12=len(d12), n_cinds_21=len(d21),
+                         n_inferred_21=int(extras[1]),
+                         n_cinds_22=len(d22))
 
     all_d = np.concatenate([cind11_d, d12, d21, d22])
     all_r = np.concatenate([cind11_r, r12, r21, r22])
@@ -948,7 +952,7 @@ def discover(triples, min_support: int, projections: str = "spo",
         rules = (frequency.mine_association_rules(triples, min_support)
                  if use_ars else None)
         if use_ars and stats is not None:
-            stats["association_rules"] = rules
+            metrics.struct_set(stats, "association_rules", rules)
         return _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count,
                                   num_caps, min_support, use_ars, rules,
                                   clean_implied, stats)
@@ -963,7 +967,7 @@ def discover(triples, min_support: int, projections: str = "spo",
     cap_code, cap_v1, cap_v2 = st["cap_code"], st["cap_v1"], st["cap_v2"]
     dep_count, num_caps = st["dep_count"], st["num_caps"]
     if stats is not None:
-        stats["pair_backend"] = "chunked"
+        metrics.gauge_set(stats, "pair_backend", "chunked")
 
     def cooc_fn(dep_ok, ref_ok, stat_key):
         return _chunked_cooc(line_val_h, line_cap_h, dep_ok, ref_ok,
@@ -984,7 +988,8 @@ def discover(triples, min_support: int, projections: str = "spo",
     rules = (frequency.mine_association_rules(triples, min_support)
              if use_ars else None)
     if use_ars and stats is not None:
-        stats["association_rules"] = rules  # driver --ar-output reuses these
+        # driver --ar-output reuses these
+        metrics.struct_set(stats, "association_rules", rules)
 
     return _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count, num_caps,
                         min_support, use_ars, rules, clean_implied, stats,
@@ -1026,7 +1031,8 @@ def _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count, num_caps,
     prop = freq_ov & ~is_cind_11
     prop_d, prop_r, prop_cnt = d11[prop], r11[prop], c11cnt[prop]
     if stats is not None:
-        stats.update(n_cinds_11=len(cind11_d), n_proper_overlaps=len(prop_d))
+        metrics.set_many(stats, n_cinds_11=len(cind11_d),
+                         n_proper_overlaps=len(prop_d))
 
     # --- Level 1/2 (findSingleDoubleCinds).
     dep_idx, mcode, mv1, mv2 = _generate_x2_candidates(
@@ -1104,8 +1110,10 @@ def _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count, num_caps,
         cap_code, cap_v1, cap_v2, min_support, "pairs_22")
 
     if stats is not None:
-        stats.update(n_cinds_12=len(cind12_d), n_cinds_21=len(cind21_d),
-                     n_inferred_21=len(inf21_dep), n_cinds_22=len(cind22_d))
+        metrics.set_many(stats, n_cinds_12=len(cind12_d),
+                         n_cinds_21=len(cind21_d),
+                         n_inferred_21=len(inf21_dep),
+                         n_cinds_22=len(cind22_d))
 
     # --- Assemble.
     all_d = np.concatenate([cind11_d, cind12_d, cind21_d, cind22_d])

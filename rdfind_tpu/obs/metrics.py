@@ -1,0 +1,332 @@
+"""The metrics registry + the sanctioned publish shims.
+
+Telemetry grew one dict at a time (DispatchStats keys in PR 1, fault-ladder
+counters in PR 3, the exchange ledger and 12-lane ingest stats in PR 4),
+each site assigning straight into a per-run ``stats`` dict.  The shims here
+are now the ONLY sanctioned way to write telemetry (tests/test_obs_guard.py
+greps for direct writes): each shim applies the identical mutation to the
+caller's legacy ``stats`` dict AND to the process-wide registry mirror, so
+
+  * every pre-existing ``stats`` key keeps its exact value and semantics
+    (``Registry.snapshot()`` reproduces them bit-for-bit — differentially
+    tested across all four sharded strategies), and
+  * the registry can serve consumers the per-run dicts never could:
+    Prometheus text exposition to a file, typed histograms, and the bench
+    artifact's unified obs snapshot.
+
+Stdlib-only at import time (the obs contract; runtime/faults.py imports
+this module).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+
+COUNTER = "counter"
+GAUGE = "gauge"
+STRUCT = "struct"
+
+# Canonical key groups shared by the --debug formatter (obs/report.py),
+# bench.py's JSON rows, and the tests — the "identical key names" contract.
+DISPATCH_KEYS = ("n_pair_passes", "n_passes_in_flight", "n_host_syncs",
+                 "host_sync_ms", "pull_overlap_ms", "n_pair_cap_retries",
+                 "cap_p_final")
+FAULT_KEYS = ("n_overflow_retries", "n_host_pull_retries", "backoff_ms_total",
+              "resumed_passes")
+INGEST_KEYS = ("n_threads", "n_units", "n_files", "bytes_read", "read_ms",
+               "parse_ms", "intern_ms", "merge_ms", "remap_ms",
+               "queue_stalls", "triples_per_sec", "bytes_per_sec")
+EXCHANGE_SITE_KEYS = ("calls", "capacity", "lanes", "bytes", "rows_capacity",
+                      "overflow_retries")
+MEMORY_KEYS = ("in_use_bytes", "peak_bytes", "limit_bytes", "frac",
+               "delta_bytes")
+
+
+class Histogram:
+    """Fixed-size summary of an observation stream (no per-sample storage)."""
+
+    __slots__ = ("count", "total", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.total += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+
+    def describe(self) -> dict:
+        if not self.count:
+            return {"count": 0}
+        return {"count": self.count, "sum": round(self.total, 3),
+                "min": round(self.min, 3), "max": round(self.max, 3),
+                "mean": round(self.total / self.count, 3)}
+
+
+class Registry:
+    """The process-wide mirror of every shim-published stats key, plus the
+    registry-only instruments (histograms)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._values: dict = {}
+        self._kinds: dict[str, str] = {}
+        self._hists: dict[str, Histogram] = {}
+
+    def apply(self, fn, key: str | None = None, kind: str | None = None):
+        with self._lock:
+            if key is not None and kind is not None:
+                self._kinds.setdefault(key, kind)
+            fn(self._values)
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            self._hists.setdefault(name, Histogram()).observe(value)
+
+    def get(self, key: str, default=None):
+        with self._lock:
+            return self._values.get(key, default)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+            self._kinds.clear()
+            self._hists.clear()
+
+    def snapshot(self, jsonable: bool = False) -> dict:
+        """Every mirrored stats key (bit-identical to the legacy dicts'
+        values) plus histogram summaries under "histograms".
+
+        jsonable=True drops values with no JSON form (numpy rule tables)
+        and deep-copies the rest, for embedding in bench artifacts.
+        """
+        with self._lock:
+            if not jsonable:
+                out = dict(self._values)
+            else:
+                out = {}
+                for k, v in self._values.items():
+                    enc = _jsonable(v)
+                    if enc is not None:
+                        out[k] = enc
+            if self._hists:
+                out["histograms"] = {n: h.describe()
+                                     for n, h in self._hists.items()}
+            return out
+
+    # -- Prometheus text exposition -----------------------------------------
+
+    def prometheus_text(self, prefix: str = "rdfind_") -> str:
+        lines: list[str] = []
+        with self._lock:
+            for key in sorted(self._values):
+                value = self._values[key]
+                kind = self._kinds.get(key, GAUGE)
+                _prom_emit(lines, prefix, key, value, kind)
+            for name in sorted(self._hists):
+                h = self._hists[name]
+                base = prefix + _prom_name(name)
+                lines.append(f"# TYPE {base} summary")
+                lines.append(f"{base}_count {h.count}")
+                lines.append(f"{base}_sum {h.total}")
+        return "\n".join(lines) + "\n"
+
+    def write_prometheus(self, path: str) -> None:
+        """Atomic exposition write (a scraper never reads a torn file)."""
+        text = self.prometheus_text()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+
+
+def _jsonable(v):
+    """JSON-ready copy of a telemetry value, or None when it has none.
+    (Mirrors runtime/checkpoint._jsonable, restated here so obs stays
+    import-light and dependency-free of the checkpoint codecs.)"""
+    if isinstance(v, (bool, str)):
+        return v
+    if isinstance(v, int):
+        return int(v)
+    if isinstance(v, float):
+        return float(v)
+    # numpy scalars quack like their Python types.
+    for proto, cast in ((int, int), (float, float)):
+        try:
+            if hasattr(v, "item") and isinstance(v.item(), proto):
+                return cast(v.item())
+        except Exception:
+            break
+    if isinstance(v, dict):
+        out = {}
+        for k, x in v.items():
+            enc = _jsonable(x)
+            if enc is None:
+                return None
+            out[str(k)] = enc
+        return out
+    if isinstance(v, (list, tuple)):
+        out = [_jsonable(x) for x in v]
+        return None if any(x is None for x in out) else out
+    return None
+
+
+def _prom_name(key: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in key)
+
+
+def _prom_emit(lines: list, prefix: str, key: str, value, kind: str,
+               labels: str = "") -> None:
+    """Numeric leaves become samples; one level of dict nesting becomes a
+    label (site=/field=); strings and deeper structures are skipped."""
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, (int, float)):
+        name = prefix + _prom_name(key)
+        if not labels:
+            lines.append(f"# TYPE {name} {kind if kind != STRUCT else GAUGE}")
+        lines.append(f"{name}{labels} {value}")
+        return
+    if isinstance(value, dict) and not labels:
+        for sub in sorted(value, key=str):
+            v = value[sub]
+            if isinstance(v, dict):
+                # e.g. exchange_sites: {site: {calls: ..}} -> per-field rows.
+                for field in sorted(v, key=str):
+                    _prom_emit(lines, prefix, f"{key}_{field}", v[field],
+                               GAUGE, labels=f'{{key="{sub}"}}')
+            else:
+                _prom_emit(lines, prefix, key, v, GAUGE,
+                           labels=f'{{key="{sub}"}}')
+    elif isinstance(value, list):
+        lines.append(f"{prefix}{_prom_name(key)}_total {len(value)}")
+
+
+_REGISTRY = Registry()
+_EXPORT_PATH: str | None = None
+
+
+def registry() -> Registry:
+    return _REGISTRY
+
+
+def reset() -> None:
+    """Clear the process-wide mirror (run boundaries, tests)."""
+    _REGISTRY.reset()
+
+
+def set_export(path: str | None) -> None:
+    """Arm (or disarm) Prometheus file exposition for this process."""
+    global _EXPORT_PATH
+    _EXPORT_PATH = path
+
+
+def export_requested() -> bool:
+    return _EXPORT_PATH is not None
+
+
+def export_path() -> str | None:
+    return _EXPORT_PATH
+
+
+def flush_export() -> None:
+    """Write the exposition file if armed (driver: run end + stage ends)."""
+    if _EXPORT_PATH is not None:
+        _REGISTRY.write_prometheus(_EXPORT_PATH)
+
+
+# ---------------------------------------------------------------------------
+# The sanctioned publish shims.  Every shim applies ONE mutation function to
+# both containers, so the legacy dict and the registry mirror can never
+# disagree on a key they both hold.
+# ---------------------------------------------------------------------------
+
+
+def mutate(stats: dict | None, fn, key: str | None = None,
+           kind: str | None = None) -> None:
+    """The root shim: apply `fn(container)` to the caller's stats dict (when
+    given) and to the registry mirror.  `fn` must derive everything it
+    writes from its own captures, reading the container only for
+    accumulation — the two containers may hold different histories."""
+    if stats is not None:
+        fn(stats)
+    _REGISTRY.apply(fn, key=key, kind=kind)
+
+
+def counter_add(stats: dict | None, key: str, n=1) -> None:
+    def fn(c):
+        c[key] = c.get(key, 0) + n
+    mutate(stats, fn, key=key, kind=COUNTER)
+
+
+def counter_max(stats: dict | None, key: str, v) -> None:
+    def fn(c):
+        c[key] = max(c.get(key, 0), v)
+    mutate(stats, fn, key=key, kind=GAUGE)
+
+
+def time_add(stats: dict | None, key: str, ms: float, ndigits: int = 3) -> None:
+    """Accumulate a duration in ms with the legacy round-to-3 convention."""
+    def fn(c):
+        c[key] = round(c.get(key, 0.0) + ms, ndigits)
+    mutate(stats, fn, key=key, kind=COUNTER)
+
+
+def gauge_set(stats: dict | None, key: str, v) -> None:
+    def fn(c):
+        c[key] = v
+    mutate(stats, fn, key=key, kind=GAUGE)
+
+
+def set_many(stats: dict | None, **kv) -> None:
+    """The stats.update(...) shim (a batch of gauge assignments)."""
+    def fn(c):
+        c.update(kv)
+    mutate(stats, fn)
+    for k in kv:
+        _REGISTRY._kinds.setdefault(k, GAUGE)
+
+
+def struct_set(stats: dict | None, key: str, value) -> None:
+    """Structured gauge (dense_plan, planned_caps, ingest, rebalance, ...)."""
+    def fn(c):
+        c[key] = value
+    mutate(stats, fn, key=key, kind=STRUCT)
+
+
+def struct_update(stats: dict | None, key: str, **kv) -> None:
+    def fn(c):
+        c.setdefault(key, {}).update(kv)
+    mutate(stats, fn, key=key, kind=STRUCT)
+
+
+def list_append(stats: dict | None, key: str, entry) -> None:
+    def fn(c):
+        c.setdefault(key, []).append(entry)
+    mutate(stats, fn, key=key, kind=STRUCT)
+
+
+def mapping_set(stats: dict | None, key: str, subkey, value) -> None:
+    def fn(c):
+        c.setdefault(key, {})[subkey] = value
+    mutate(stats, fn, key=key, kind=STRUCT)
+
+
+def restore(stats: dict | None, decoded: dict) -> None:
+    """Re-publish a decoded stats dict (checkpoint resume): the resumed run
+    must report the same stat-* counters as the run that produced it."""
+    def fn(c):
+        c.update(decoded)
+    mutate(stats, fn)
+
+
+def observe(name: str, value: float) -> None:
+    """Registry-only histogram observation (no legacy key)."""
+    _REGISTRY.observe(name, value)

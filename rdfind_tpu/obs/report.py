@@ -1,0 +1,285 @@
+"""Trace merging/export + the one formatter behind every counter report.
+
+Two jobs live here:
+
+* **Trace tooling.**  Each host's tracer writes ``events-host<N>.jsonl``;
+  ``export_chrome_trace`` merges them into one Chrome-trace JSON (per-host
+  lanes via the pid field, timestamps rebased to the earliest event) that
+  loads in Perfetto (ui.perfetto.dev) or chrome://tracing.  Run standalone:
+  ``python -m rdfind_tpu.obs.report TRACE_DIR``.  ``build_span_tree``
+  reconstructs the span hierarchy from the B/E stream — the integrity
+  check the obs tests pin (every open span closes, passes nest under
+  stages).
+
+* **Counter formatting.**  Before this module, the driver, bench.py and the
+  tests each formatted dispatch/exchange/ingest counters their own way.
+  ``format_debug_lines`` / ``format_counter_lines`` / ``format_timing_lines``
+  are now the single rendering of the legacy stats keys (the key lists
+  themselves live in obs/metrics.py).
+
+Stdlib-only (the obs contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from . import metrics
+from .tracer import EVENTS_PREFIX
+
+TRACE_FILE = "trace.json"
+
+
+# ---------------------------------------------------------------------------
+# Trace loading / merging / export.
+# ---------------------------------------------------------------------------
+
+
+def load_events(path: str) -> list[dict]:
+    """Events from one per-host JSONL file (torn tail lines are skipped —
+    a preempted run's file ends mid-write by design)."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def host_event_files(trace_dir: str) -> dict[int, str]:
+    """{host_index: path} of every per-host event file in the directory."""
+    out = {}
+    try:
+        names = os.listdir(trace_dir)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(EVENTS_PREFIX) and name.endswith(".jsonl")):
+            continue
+        try:
+            h = int(name[len(EVENTS_PREFIX):-len(".jsonl")])
+        except ValueError:
+            continue
+        out[h] = os.path.join(trace_dir, name)
+    return out
+
+
+def merge_traces(trace_dir: str) -> dict:
+    """All hosts' events as one Chrome-trace object with per-host lanes.
+
+    Every event's pid is forced to the host index from its FILE name (the
+    authoritative lane assignment; a mislabeled event cannot jump lanes),
+    timestamps are rebased to the earliest event across hosts, and each
+    lane gets a process_name metadata record so Perfetto shows "host N".
+    """
+    events: list[dict] = []
+    files = host_event_files(trace_dir)
+    for h in sorted(files):
+        for ev in load_events(files[h]):
+            ev["pid"] = h
+            events.append(ev)
+    t0 = min((ev["ts"] for ev in events if "ts" in ev), default=0)
+    for ev in events:
+        if "ts" in ev:
+            ev["ts"] = ev["ts"] - t0
+    meta = [{"name": "process_name", "ph": "M", "pid": h, "tid": 0,
+             "args": {"name": f"host {h}"}} for h in sorted(files)]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(trace_dir: str, out_path: str | None = None) -> str:
+    """Write the merged Chrome-trace JSON next to the event files."""
+    trace = merge_traces(trace_dir)
+    out_path = out_path or os.path.join(trace_dir, TRACE_FILE)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(trace, f, default=str)
+    os.replace(tmp, out_path)
+    return out_path
+
+
+def build_span_tree(events: list[dict]) -> tuple[list[dict], list[dict]]:
+    """(roots, unclosed) span trees from a B/E event stream.
+
+    Spans nest per (pid, tid) lane in stream order.  Each node is
+    {"name", "cat", "ts", "dur", "args", "children"}; `unclosed` lists
+    spans whose E event never arrived (empty on a clean run — the span-tree
+    integrity contract).
+    """
+    stacks: dict[tuple, list[dict]] = {}
+    roots: list[dict] = []
+    unclosed: list[dict] = []
+    for ev in events:
+        lane = (ev.get("pid"), ev.get("tid"))
+        stack = stacks.setdefault(lane, [])
+        ph = ev.get("ph")
+        if ph == "B":
+            node = {"name": ev.get("name"), "cat": ev.get("cat"),
+                    "ts": ev.get("ts"), "dur": None,
+                    "args": ev.get("args", {}), "children": []}
+            (stack[-1]["children"] if stack else roots).append(node)
+            stack.append(node)
+        elif ph == "E":
+            while stack:
+                node = stack.pop()
+                node["dur"] = ev.get("ts", node["ts"]) - node["ts"]
+                if node["name"] == ev.get("name"):
+                    break
+        elif ph == "i":
+            node = {"name": ev.get("name"), "cat": ev.get("cat"),
+                    "ts": ev.get("ts"), "dur": 0,
+                    "args": ev.get("args", {}), "children": []}
+            (stack[-1]["children"] if stack else roots).append(node)
+    for stack in stacks.values():
+        unclosed.extend(stack)
+    return roots, unclosed
+
+
+def walk_spans(roots: list[dict]):
+    """Depth-first (node, parent) pairs over a span forest."""
+    todo = [(n, None) for n in roots]
+    while todo:
+        node, parent = todo.pop()
+        yield node, parent
+        todo.extend((c, node) for c in node["children"])
+
+
+# ---------------------------------------------------------------------------
+# The one counter formatter (--debug / -c / bench share these renderings).
+# ---------------------------------------------------------------------------
+
+
+def format_debug_lines(stats: dict) -> list[str]:
+    """Every --debug stats line the driver prints, in fixed order, rendered
+    from the canonical key groups in obs/metrics.py."""
+    lines: list[str] = []
+    ing = stats.get("ingest")
+    if ing:
+        # Parallel-ingest telemetry: phase split (worker phases are sums
+        # across threads), throughput, and the consumer-side stall count.
+        lines.append(
+            f"ingest: threads={ing.get('n_threads')} "
+            f"units={ing.get('n_units')} files={ing.get('n_files')} "
+            f"bytes={ing.get('bytes_read')} "
+            f"read_ms={ing.get('read_ms')} parse_ms={ing.get('parse_ms')} "
+            f"intern_ms={ing.get('intern_ms')} "
+            f"merge_ms={ing.get('merge_ms')} remap_ms={ing.get('remap_ms')} "
+            f"stalls={ing.get('queue_stalls')} "
+            f"triples/s={ing.get('triples_per_sec')} "
+            f"bytes/s={ing.get('bytes_per_sec')}")
+    if stats.get("exchange_sites"):
+        # Per-exchange communication ledger: fixed-shape collective volume
+        # per site, the input to multi-chip bandwidth projections.
+        for site, e in sorted(stats["exchange_sites"].items()):
+            lines.append(
+                f"exchange[{site}]: calls={e['calls']} "
+                f"capacity={e['capacity']} lanes={e['lanes']} "
+                f"bytes={e['bytes']} rows_capacity={e['rows_capacity']} "
+                f"overflow_retries={e['overflow_retries']}")
+    if "dense_plan" in stats:
+        # Dense cooc occupancy: the roofline-correcting record (issued vs
+        # real FLOPs of the scheduled tile sweep) plus the resolved dtype.
+        dp = stats["dense_plan"]
+        lines.append(
+            f"dense plan: dtype={stats.get('cooc_dtype')} "
+            f"policy={dp['policy']} "
+            f"lines={dp['l_real']}/{dp['l_pad']} "
+            f"caps={dp['c_real']}/{dp['c_pad']} tile={dp['tile']} "
+            f"tiles={dp['n_tiles'] - dp['n_tiles_skipped']}"
+            f"/{dp['n_tiles']} occupancy={dp['occupancy']}")
+    elif "cooc_dtype" in stats:
+        lines.append(f"cooc dtype: {stats['cooc_dtype']}")
+    if "n_host_syncs" in stats:
+        # Dispatch telemetry of the pipelined pass executor: proof the
+        # compute/readback overlap happened, not an assertion of it.
+        lines.append(
+            f"dispatch: passes={stats.get('n_pair_passes', 1)} "
+            f"in_flight={stats.get('n_passes_in_flight', 1)} "
+            f"host_syncs={stats['n_host_syncs']} "
+            f"sync_ms={stats.get('host_sync_ms', 0.0):.1f} "
+            f"overlap_ms={stats.get('pull_overlap_ms', 0.0):.1f} "
+            f"cap_retries={stats.get('n_pair_cap_retries', 0)} "
+            f"cap_p={stats.get('cap_p_final', 0)}")
+    if stats.get("hbm"):
+        hbm = stats["hbm"]
+        lines.append(
+            f"hbm: in_use={hbm.get('in_use_bytes')} "
+            f"peak={hbm.get('peak_bytes')} limit={hbm.get('limit_bytes')} "
+            f"frac={hbm.get('frac')} delta={hbm.get('delta_bytes')}")
+    if stats.get("degradations"):
+        # The degradation ledger: every ladder rung the run took instead of
+        # dying (grow / split / skip / fallback), in order.
+        for step in stats["degradations"]:
+            lines.append(f"degradation: {step}")
+        lines.append(f"ladder rungs: {stats.get('ladder_rung', {})}")
+    if (stats.get("n_overflow_retries") or stats.get("n_host_pull_retries")
+            or stats.get("resumed_passes")):
+        lines.append(
+            f"fault recovery: overflow_retries="
+            f"{stats.get('n_overflow_retries', 0)} "
+            f"host_pull_retries={stats.get('n_host_pull_retries', 0)} "
+            f"backoff_ms={stats.get('backoff_ms_total', 0.0):.1f} "
+            f"resumed_passes={stats.get('resumed_passes', 0)}")
+    return lines
+
+
+def format_counter_lines(counters: dict) -> list[str]:
+    """The -c counter report (sorted `key: value` lines)."""
+    return [f"{k}: {v}" for k, v in sorted(counters.items())]
+
+
+def format_timing_lines(timings: dict, counters: dict | None = None) -> list[str]:
+    """Phase wall-clock report + the machine-readable CSV line
+    (AbstractFlinkProgram.java:149-182)."""
+    total = sum(timings.values())
+    lines = [f"phase {name}: {secs * 1000:.1f} ms"
+             for name, secs in timings.items()]
+    lines.append(f"total: {total * 1000:.1f} ms")
+    counters = counters or {}
+    csv = ",".join([f"{timings.get(k, 0.0) * 1000:.0f}"
+                    for k in ("read+parse", "intern", "discover")]
+                   + [f"{total * 1000:.0f}",
+                      str(counters.get("cind-counter", 0))])
+    lines.append(f"csv:{csv}")
+    return lines
+
+
+def dispatch_row(stats: dict) -> dict:
+    """The dispatch+fault telemetry row bench.py embeds per mode — built
+    from the canonical key groups so bench, driver and tests cannot drift."""
+    return {k: stats.get(k)
+            for k in metrics.DISPATCH_KEYS + metrics.FAULT_KEYS[:3]}
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m rdfind_tpu.obs.report",
+        description="Merge per-host trace event files into one Chrome-trace "
+                    "JSON (open in Perfetto: ui.perfetto.dev).")
+    ap.add_argument("trace_dir", help="directory holding events-host*.jsonl")
+    ap.add_argument("-o", "--output", default=None,
+                    help="output path (default: TRACE_DIR/trace.json)")
+    args = ap.parse_args(argv)
+    files = host_event_files(args.trace_dir)
+    if not files:
+        print(f"no {EVENTS_PREFIX}*.jsonl files in {args.trace_dir}")
+        return 1
+    out = export_chrome_trace(args.trace_dir, args.output)
+    n = sum(len(load_events(p)) for p in files.values())
+    print(f"wrote {out} ({len(files)} host lane(s), {n} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import metrics, tracer
 from ..ops import hashing, segments
 
 SENTINEL = segments.SENTINEL
@@ -60,18 +61,24 @@ def log_exchange(stats, site: str, *, num_dev: int, capacity: int,
     """
     if stats is None:
         return
-    sites = stats.setdefault("exchange_sites", {})
-    e = sites.setdefault(site, dict(calls=0, capacity=0, lanes=lanes,
-                                    bytes=0, rows_capacity=0, rows=0,
-                                    overflow_retries=0))
-    e["calls"] += calls
-    e["capacity"] = max(e["capacity"], int(capacity))
-    e["lanes"] = lanes
-    e["bytes"] += calls * exchange_volume_bytes(num_dev, capacity, lanes)
-    e["rows_capacity"] += calls * int(num_dev) * int(capacity)
-    if rows is not None:
-        e["rows"] += int(rows)
-    e["overflow_retries"] += retries
+    nbytes = calls * exchange_volume_bytes(num_dev, capacity, lanes)
+
+    def fn(c):
+        e = c.setdefault("exchange_sites", {}).setdefault(
+            site, dict(calls=0, capacity=0, lanes=lanes, bytes=0,
+                       rows_capacity=0, rows=0, overflow_retries=0))
+        e["calls"] += calls
+        e["capacity"] = max(e["capacity"], int(capacity))
+        e["lanes"] = lanes
+        e["bytes"] += nbytes
+        e["rows_capacity"] += calls * int(num_dev) * int(capacity)
+        if rows is not None:
+            e["rows"] += int(rows)
+        e["overflow_retries"] += retries
+
+    metrics.mutate(stats, fn, key="exchange_sites", kind=metrics.STRUCT)
+    tracer.instant("exchange", cat=tracer.CAT_EXCHANGE, site=site,
+                   calls=calls, capacity=int(capacity), bytes=nbytes)
 
 
 def log_exchange_retry(stats, site: str) -> None:
@@ -79,11 +86,15 @@ def log_exchange_retry(stats, site: str) -> None:
     so a retry before the first successful dispatch still lands)."""
     if stats is None:
         return
-    sites = stats.setdefault("exchange_sites", {})
-    e = sites.setdefault(site, dict(calls=0, capacity=0, lanes=0, bytes=0,
-                                    rows_capacity=0, rows=0,
-                                    overflow_retries=0))
-    e["overflow_retries"] += 1
+
+    def fn(c):
+        e = c.setdefault("exchange_sites", {}).setdefault(
+            site, dict(calls=0, capacity=0, lanes=0, bytes=0,
+                       rows_capacity=0, rows=0, overflow_retries=0))
+        e["overflow_retries"] += 1
+
+    metrics.mutate(stats, fn, key="exchange_sites", kind=metrics.STRUCT)
+    tracer.instant("exchange_retry", cat=tracer.CAT_EXCHANGE, site=site)
 
 
 def pack_counters(values):

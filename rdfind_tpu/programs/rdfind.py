@@ -63,6 +63,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="write an XLA profiler trace of the run (per-op "
                         "device timings; open with TensorBoard)")
+    p.add_argument("--trace", default=None, metavar="DIR", dest="trace_dir",
+                   help="obs: record host span traces (run/stage/pass/"
+                        "dispatch/pull/exchange/checkpoint) + a heartbeat "
+                        "file into DIR; a merged Chrome-trace JSON "
+                        "(DIR/trace.json, per-host lanes) is written at run "
+                        "end — open in Perfetto (ui.perfetto.dev).  Pairs "
+                        "with --profile-dir: host spans emit matching "
+                        "jax.profiler.TraceAnnotations")
+    p.add_argument("--metrics-file", default=None, metavar="FILE",
+                   help="obs: write the metrics registry as Prometheus text "
+                        "exposition to FILE (atomically refreshed at every "
+                        "stage boundary and at run end)")
     p.add_argument("--counters", type=int, default=0, dest="counter_level")
     p.add_argument("--dop", type=int, default=1,
                    help="degree of parallelism = number of devices in the mesh")
@@ -241,6 +253,8 @@ def main(argv=None) -> int:
         create_join_histogram=args.create_join_histogram,
         sharded_ingest=args.sharded_ingest,
         interning=args.interning,
+        trace_dir=args.trace_dir,
+        metrics_file=args.metrics_file,
     )
     # Un-silence the remaining compatibility no-ops (the reference's
     # JVM-dataflow levers that the TPU design subsumes).

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..data import CindTable
 from ..dictionary import Dictionary
+from ..obs import tracer
 from . import faults
 
 
@@ -75,6 +76,11 @@ class CheckpointStore:
         return os.path.join(self.dir, f"{stage}.npz")
 
     def save(self, stage: str, fp: str, arrays: dict) -> None:
+        with tracer.span("checkpoint", cat=tracer.CAT_CHECKPOINT,
+                         stage=stage):
+            self._save(stage, fp, arrays)
+
+    def _save(self, stage: str, fp: str, arrays: dict) -> None:
         faults.maybe_fail("checkpoint_write")
         tmp = self._path(stage) + ".tmp.npz"  # .npz suffix: savez won't rename
         np.savez(tmp, __fingerprint__=np.frombuffer(fp.encode(), np.uint8),
@@ -321,9 +327,8 @@ class ProgressStore:
 
 
 def decode_stats(arrays: dict) -> dict:
-    if "__stats__" not in arrays:
-        return {}
-    stats = json.loads(bytes(arrays["__stats__"]).decode())
+    decoded = json.loads(bytes(arrays["__stats__"]).decode()) \
+        if "__stats__" in arrays else {}
     if "__rules_0__" in arrays:
         # Column count derives from the stored keys, not a hard-coded schema:
         # a rule-table shape change then reads back exactly what was written
@@ -331,5 +336,5 @@ def decode_stats(arrays: dict) -> dict:
         cols = []
         while f"__rules_{len(cols)}__" in arrays:
             cols.append(arrays[f"__rules_{len(cols)}__"])
-        stats["association_rules"] = cols
-    return stats
+        decoded["association_rules"] = cols
+    return decoded

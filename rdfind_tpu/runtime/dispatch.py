@@ -21,14 +21,17 @@ shared machinery of the pipelined executors (models/sharded._Pipeline
     work — the telemetry that lets bench.py and --debug output PROVE the
     overlap happened instead of asserting it.
 
-This module must stay import-light (os/time only, jax lazily at call sites'
-expense): ops/ and models/ import it, and runtime/driver imports models/.
+This module must stay import-light (stdlib + the stdlib-only obs package,
+jax lazily at call sites' expense): ops/ and models/ import it, and
+runtime/driver imports models/.
 """
 
 from __future__ import annotations
 
 import os
 import time
+
+from ..obs import metrics, tracer
 
 
 def sync_passes_forced() -> bool:
@@ -114,11 +117,16 @@ class DispatchStats:
         if overlapped:
             self.pull_overlap_ms += seconds * 1e3
 
-    def timed_pull(self, fn, overlapped: bool):
-        """Run a blocking pull `fn()` under the sync clock; returns its value."""
+    def timed_pull(self, fn, overlapped: bool, what: str = "pull"):
+        """Run a blocking pull `fn()` under the sync clock; returns its value.
+        The pull rides a host span (+ matching device TraceAnnotation) so a
+        merged trace shows exactly which reads blocked and for how long."""
         t0 = time.perf_counter()
-        out = fn()
-        self.pulled(time.perf_counter() - t0, overlapped)
+        with tracer.span(what, cat=tracer.CAT_PULL, overlapped=overlapped):
+            out = fn()
+        dt = time.perf_counter() - t0
+        self.pulled(dt, overlapped)
+        metrics.observe("host_pull_ms", dt * 1e3)
         return out
 
     def publish(self, stats: dict | None) -> None:
@@ -126,15 +134,11 @@ class DispatchStats:
         the S2L lattice calls run_cooc once per level)."""
         if stats is None:
             return
-        stats["n_host_syncs"] = stats.get("n_host_syncs", 0) + self.n_host_syncs
-        stats["host_sync_ms"] = round(
-            stats.get("host_sync_ms", 0.0) + self.host_sync_ms, 3)
-        stats["pull_overlap_ms"] = round(
-            stats.get("pull_overlap_ms", 0.0) + self.pull_overlap_ms, 3)
-        stats["n_passes_in_flight"] = max(
-            stats.get("n_passes_in_flight", 0), self.max_in_flight)
-        stats["n_pair_cap_retries"] = (
-            stats.get("n_pair_cap_retries", 0) + self.n_cap_retries)
+        metrics.counter_add(stats, "n_host_syncs", self.n_host_syncs)
+        metrics.time_add(stats, "host_sync_ms", self.host_sync_ms)
+        metrics.time_add(stats, "pull_overlap_ms", self.pull_overlap_ms)
+        metrics.counter_max(stats, "n_passes_in_flight", self.max_in_flight)
+        metrics.counter_add(stats, "n_pair_cap_retries", self.n_cap_retries)
         from . import faults
 
         pulls = faults.pull_stats()
@@ -143,13 +147,11 @@ class DispatchStats:
         d_backoff = (pulls["backoff_ms_total"]
                      - self._pull_base["backoff_ms_total"])
         if self._pull_absolute:
-            stats["n_host_pull_retries"] = d_retries
-            stats["backoff_ms_total"] = round(d_backoff, 3)
+            metrics.gauge_set(stats, "n_host_pull_retries", d_retries)
+            metrics.gauge_set(stats, "backoff_ms_total", round(d_backoff, 3))
         else:
-            stats["n_host_pull_retries"] = (
-                stats.get("n_host_pull_retries", 0) + d_retries)
-            stats["backoff_ms_total"] = round(
-                stats.get("backoff_ms_total", 0.0) + d_backoff, 3)
+            metrics.counter_add(stats, "n_host_pull_retries", d_retries)
+            metrics.time_add(stats, "backoff_ms_total", d_backoff)
             # The delta is consumed; re-baseline so a second publish (the
             # S2L lattice publishes once per level) never double-counts.
             self._pull_base = pulls

@@ -23,6 +23,8 @@ from ..data import CindTable
 from ..dictionary import Dictionary, intern_triples
 from ..io import native, ntriples, prefixes, reader
 from ..models import allatonce, approximate, late_bb, sharded, small_to_large
+from ..obs import memory as obs_memory
+from ..obs import metrics, report, tracer
 from ..parallel.mesh import make_mesh
 from . import checkpoint
 
@@ -73,6 +75,8 @@ class Config:
     create_join_histogram: bool = False  # print join-line size histogram
     sharded_ingest: bool = False  # each host parses only its file subset
     interning: str = "auto"  # sharded-ingest dictionary: partitioned|replicated
+    trace_dir: str | None = None  # obs: host span trace + heartbeat directory
+    metrics_file: str | None = None  # obs: Prometheus text exposition file
 
 
 @dataclasses.dataclass
@@ -93,8 +97,16 @@ class _Phases:
 
     def run(self, name, fn):
         t0 = time.perf_counter()
-        out = fn()
+        with tracer.span(name, cat=tracer.CAT_STAGE):
+            out = fn()
         self.timings[name] = time.perf_counter() - t0
+        metrics.observe(f"stage_{name}_ms", self.timings[name] * 1e3)
+        if tracer.enabled() or metrics.export_requested():
+            # Stage-boundary HBM watermark (the coarse lane; the pass
+            # executor samples per pass) + a fresh exposition snapshot so a
+            # scraper sees progress mid-run, not only at exit.
+            obs_memory.sample(None, label=f"stage {name}")
+            metrics.flush_export()
         return out
 
 
@@ -512,7 +524,7 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
         if hit:
             table = phases.run("resume-discover",
                                lambda: checkpoint.decode_cinds(stored))
-            stats.update(checkpoint.decode_stats(stored))
+            metrics.restore(stats, checkpoint.decode_stats(stored))
             counters["resumed-discover"] = 1
     if table is None:
         table = phases.run("discover", lambda: discover_fn(
@@ -535,9 +547,9 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
             and "association_rules" not in stats):
         # --ar-output without --use-ars: rules were not mined during
         # discovery; mine them over the preshard (no host triple table).
-        stats["association_rules"] = phases.run(
+        metrics.struct_set(stats, "association_rules", phases.run(
             "mine-ars", lambda: sharded.mine_ars_sharded(
-                g_triples, g_valid, cfg.min_support, mesh))
+                g_triples, g_valid, cfg.min_support, mesh)))
     counters.update({f"stat-{k}": v for k, v in stats.items()})
     if isinstance(dictionary, multihost_ingest.PartitionedDictionary):
         # Hash-partitioned interning: no host holds the union, so decoding the
@@ -606,8 +618,50 @@ def _safe_save(ckpt: "checkpoint.CheckpointStore", stage: str, fp: str,
 
 
 def run(cfg: Config) -> RunResult:
-    with _flush_progress_on_signal(bool(cfg.checkpoint_dir)):
-        return _run_profiled(cfg)
+    with _obs_session(cfg):
+        with _flush_progress_on_signal(bool(cfg.checkpoint_dir)):
+            with tracer.span("run", cat=tracer.CAT_RUN,
+                             strategy=cfg.traversal_strategy,
+                             n_devices=cfg.n_devices):
+                return _run_profiled(cfg)
+
+
+@contextlib.contextmanager
+def _obs_session(cfg: Config):
+    """Arm the obs layer for one driver run (span tracing + heartbeat when
+    --trace/RDFIND_TRACE names a directory, Prometheus exposition when
+    --metrics-file/RDFIND_METRICS_FILE names a file), and tear it down —
+    exporting the merged Chrome trace on the primary host — no matter how
+    the run ends.  With neither knob set this is a no-op and the run pays
+    only the disabled-path checks."""
+    trace_dir = cfg.trace_dir or os.environ.get("RDFIND_TRACE") or None
+    metrics_file = (cfg.metrics_file
+                    or os.environ.get("RDFIND_METRICS_FILE") or None)
+    obs_memory.reset()
+    if metrics_file:
+        metrics.set_export(metrics_file)
+    if trace_dir:
+        tracer.start(trace_dir)
+    try:
+        yield
+    finally:
+        if metrics_file:
+            try:
+                metrics.flush_export()
+            finally:
+                metrics.set_export(None)
+        if trace_dir:
+            tracer.stop()
+            if _is_primary():
+                # Best-effort merge: on a shared filesystem this folds every
+                # host's lane in; per-host dirs still get a loadable
+                # single-lane trace (obs/report.py re-merges offline).
+                try:
+                    report.export_chrome_trace(trace_dir)
+                except OSError as e:
+                    print(f"warning: trace export failed ({e}); the raw "
+                          f"event files remain in {trace_dir}",
+                          file=sys.stderr)
 
 
 def _run_profiled(cfg: Config) -> RunResult:
@@ -670,7 +724,7 @@ def _run(cfg: Config) -> RunResult:
                     paths, tabs=cfg.tabs, expect_quad=is_nq,
                     stats=ingest_stats))
             if ingest_stats:
-                stats["ingest"] = ingest_stats
+                metrics.struct_set(stats, "ingest", ingest_stats)
                 _ingest_counters(counters, stats)
             counters["input-triples"] = ids.shape[0]
             phases.timings["intern"] = 0.0  # folded into the native pass
@@ -843,7 +897,7 @@ def _run(cfg: Config) -> RunResult:
         if stored is not None:
             table = phases.run("resume-discover",
                                lambda: checkpoint.decode_cinds(stored))
-            stats.update(checkpoint.decode_stats(stored))
+            metrics.restore(stats, checkpoint.decode_stats(stored))
             counters["resumed-discover"] = 1
     if table is None:
         table = phases.run("discover", discover)
@@ -877,80 +931,18 @@ def _ingest_counters(counters: dict, stats: dict) -> None:
 def _emit_sinks(cfg: Config, phases: _Phases, counters: dict, table,
                 dictionary, stats: dict, ids) -> None:
     """Debug reports + every result sink; shared by the replicated and the
-    sharded-ingest paths so they can never diverge."""
-    if cfg.debug_level >= 1 and "ingest" in stats and _is_primary():
-        # Parallel-ingest telemetry: phase split (worker phases are sums
-        # across threads), throughput, and the consumer-side stall count
-        # (how often the in-order block delivery had to wait on a unit).
-        ing = stats["ingest"]
-        print(f"ingest: threads={ing.get('n_threads')} "
-              f"units={ing.get('n_units')} files={ing.get('n_files')} "
-              f"bytes={ing.get('bytes_read')} "
-              f"read_ms={ing.get('read_ms')} parse_ms={ing.get('parse_ms')} "
-              f"intern_ms={ing.get('intern_ms')} "
-              f"merge_ms={ing.get('merge_ms')} remap_ms={ing.get('remap_ms')} "
-              f"stalls={ing.get('queue_stalls')} "
-              f"triples/s={ing.get('triples_per_sec')} "
-              f"bytes/s={ing.get('bytes_per_sec')}", file=sys.stderr)
-
-    if cfg.debug_level >= 1 and stats.get("exchange_sites") and _is_primary():
-        # Per-exchange communication ledger (parallel/exchange.log_exchange):
-        # fixed-shape collective volume per site, the input to multi-chip
-        # bandwidth projections.
-        for site, e in sorted(stats["exchange_sites"].items()):
-            print(f"exchange[{site}]: calls={e['calls']} "
-                  f"capacity={e['capacity']} lanes={e['lanes']} "
-                  f"bytes={e['bytes']} rows_capacity={e['rows_capacity']} "
-                  f"overflow_retries={e['overflow_retries']}",
-                  file=sys.stderr)
+    sharded-ingest paths so they can never diverge.  All stats rendering
+    goes through the ONE obs formatter (obs/report.format_debug_lines), so
+    the driver, bench.py and the tests share key names by construction."""
+    if cfg.debug_level >= 1 and _is_primary():
+        for line in report.format_debug_lines(stats):
+            print(line, file=sys.stderr)
     if cfg.debug_level >= 1 and len(table) and _is_primary():
         # Per-family CIND counts (TraversalStrategy.scala:101-107).
         fams = table.family_counts()
         print("CIND families: " + ", ".join(
             f"{k[0]}/{k[1]}: {v}" for k, v in fams.items()), file=sys.stderr)
         counters.update({f"cinds-{k}": v for k, v in fams.items()})
-
-    if cfg.debug_level >= 1 and "dense_plan" in stats and _is_primary():
-        # Dense cooc occupancy: the roofline-correcting record (issued vs
-        # real FLOPs of the scheduled tile sweep) plus the resolved dtype.
-        dp = stats["dense_plan"]
-        print(f"dense plan: dtype={stats.get('cooc_dtype')} "
-              f"policy={dp['policy']} "
-              f"lines={dp['l_real']}/{dp['l_pad']} "
-              f"caps={dp['c_real']}/{dp['c_pad']} tile={dp['tile']} "
-              f"tiles={dp['n_tiles'] - dp['n_tiles_skipped']}"
-              f"/{dp['n_tiles']} occupancy={dp['occupancy']}",
-              file=sys.stderr)
-    elif cfg.debug_level >= 1 and "cooc_dtype" in stats and _is_primary():
-        print(f"cooc dtype: {stats['cooc_dtype']}", file=sys.stderr)
-
-    if cfg.debug_level >= 1 and "n_host_syncs" in stats and _is_primary():
-        # Dispatch telemetry of the pipelined pass executor (sharded runs):
-        # proof the compute/readback overlap happened, not an assertion of it.
-        print(f"dispatch: passes={stats.get('n_pair_passes', 1)} "
-              f"in_flight={stats.get('n_passes_in_flight', 1)} "
-              f"host_syncs={stats['n_host_syncs']} "
-              f"sync_ms={stats.get('host_sync_ms', 0.0):.1f} "
-              f"overlap_ms={stats.get('pull_overlap_ms', 0.0):.1f} "
-              f"cap_retries={stats.get('n_pair_cap_retries', 0)} "
-              f"cap_p={stats.get('cap_p_final', 0)}", file=sys.stderr)
-
-    if cfg.debug_level >= 1 and stats.get("degradations") and _is_primary():
-        # The degradation ledger: every ladder rung the run took instead of
-        # dying (grow / split / skip / fallback), in order.
-        for step in stats["degradations"]:
-            print(f"degradation: {step}", file=sys.stderr)
-        print(f"ladder rungs: {stats.get('ladder_rung', {})}",
-              file=sys.stderr)
-    if cfg.debug_level >= 1 and _is_primary() and (
-            stats.get("n_overflow_retries") or stats.get("n_host_pull_retries")
-            or stats.get("resumed_passes")):
-        print(f"fault recovery: overflow_retries="
-              f"{stats.get('n_overflow_retries', 0)} "
-              f"host_pull_retries={stats.get('n_host_pull_retries', 0)} "
-              f"backoff_ms={stats.get('backoff_ms_total', 0.0):.1f} "
-              f"resumed_passes={stats.get('resumed_passes', 0)}",
-              file=sys.stderr)
 
     if cfg.debug_level >= 2 and len(table):
         # DEBUG_LEVEL_SANITY: trivial CINDs in the output indicate a pipeline
@@ -1043,17 +1035,11 @@ def _report(cfg: Config, counters: dict, timings: dict) -> None:
             print(f"peak-rss-mb: {counters['peak-rss-mb']}", file=sys.stderr)
         return
     if cfg.counter_level >= 1:
-        for k, v in sorted(counters.items()):
-            print(f"{k}: {v}", file=sys.stderr)
+        for line in report.format_counter_lines(counters):
+            print(line, file=sys.stderr)
     if cfg.debug_level >= 1 or cfg.counter_level >= 1:
-        total = sum(timings.values())
-        for name, secs in timings.items():
-            print(f"phase {name}: {secs * 1000:.1f} ms", file=sys.stderr)
-        print(f"total: {total * 1000:.1f} ms", file=sys.stderr)
-        csv = ",".join([f"{timings.get(k, 0.0) * 1000:.0f}"
-                        for k in ("read+parse", "intern", "discover")]
-                       + [f"{total * 1000:.0f}", str(counters.get("cind-counter", 0))])
-        print(f"csv:{csv}", file=sys.stderr)
+        for line in report.format_timing_lines(timings, counters):
+            print(line, file=sys.stderr)
 
 
 # Strategy ids follow the reference (RDFind.scala:50-56): 0 = all-at-once,

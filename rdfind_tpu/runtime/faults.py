@@ -44,8 +44,8 @@ Host pulls additionally get bounded retry with exponential backoff + jitter
 RDFIND_BACKOFF_MAX_MS), with telemetry accumulated module-wide
 (``pull_stats``) and published into stats by the dispatch layer.
 
-Import-light by design (stdlib only): parallel/mesh.py and
-runtime/checkpoint.py both import this module.
+Import-light by design (stdlib + the stdlib-only obs package):
+parallel/mesh.py and runtime/checkpoint.py both import this module.
 """
 
 from __future__ import annotations
@@ -54,6 +54,8 @@ import dataclasses
 import os
 import random
 import time
+
+from ..obs import metrics, tracer
 
 
 class FaultError(RuntimeError):
@@ -234,8 +236,10 @@ def record_degradation(stats: dict | None, phase: str, action: str,
     if stats is None:
         return
     entry = {"phase": phase, "action": action, **detail}
-    stats.setdefault("degradations", []).append(entry)
-    stats.setdefault("ladder_rung", {})[phase] = action
+    metrics.list_append(stats, "degradations", entry)
+    metrics.mapping_set(stats, "ladder_rung", phase, action)
+    tracer.instant("degradation", cat=tracer.CAT_DISPATCH, phase=phase,
+                   action=action)
 
 
 def max_pass_splits(default: int = 2) -> int:

@@ -25,6 +25,7 @@ import dataclasses
 import numpy as np
 
 from ..dictionary import Dictionary
+from ..obs import metrics
 from ..io import native, ntriples, reader
 
 
@@ -72,8 +73,8 @@ def _local_ingest(paths, tabs: bool, expect_quad: bool, encoding,
         return np.zeros((0, 3), np.int32), Dictionary(np.zeros(0, object))
     out = intern_triples(np.asarray(rows, dtype=object))
     if stats is not None:
-        stats.update(n_threads=1, triples=int(out[0].shape[0]),
-                     values=len(out[1]), parser="python")
+        metrics.set_many(stats, n_threads=1, triples=int(out[0].shape[0]),
+                         values=len(out[1]), parser="python")
     return out
 
 
@@ -349,7 +350,7 @@ def sharded_ingest(paths: list[str], mesh, *, tabs: bool = False,
                                               transform=transform,
                                               stats=ingest_stats)
         if stats is not None and ingest_stats:
-            stats["ingest"] = ingest_stats
+            metrics.struct_set(stats, "ingest", ingest_stats)
         if cache is not None:
             cache.save(stage, cache_fp,
                        ckpt_mod.encode_ingest(local_ids, local_dict))

@@ -1,0 +1,367 @@
+"""Unified observability layer (rdfind_tpu/obs): span tracing, the metrics
+registry's legacy-stats parity, HBM watermarks, trace merge, heartbeat, and
+the disabled-path overhead bound (ISSUE 5 acceptance)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from rdfind_tpu.models import sharded
+from rdfind_tpu.obs import heartbeat, memory, metrics, report, tracer
+from rdfind_tpu.parallel.mesh import make_mesh
+from rdfind_tpu.utils.synth import generate_triples
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 CPU devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test starts and ends with the obs layer disarmed."""
+    tracer.stop()
+    metrics.reset()
+    memory.reset()
+    memory._stats_fn = None
+    yield
+    tracer.stop()
+    metrics.reset()
+    memory.reset()
+    memory._stats_fn = None
+
+
+STRATEGIES = {
+    0: sharded.discover_sharded,
+    1: sharded.discover_sharded_s2l,
+    2: sharded.discover_sharded_approx,
+    3: sharded.discover_sharded_late_bb,
+}
+
+
+def _equal(a, b) -> bool:
+    """Bit-for-bit stats equality incl. numpy columns (association_rules)."""
+    if a is b:
+        return True
+    if isinstance(a, dict) and isinstance(b, dict):
+        return a.keys() == b.keys() and all(_equal(a[k], b[k]) for k in a)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(_equal(x, y) for x, y in zip(a, b))
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return np.array_equal(np.asarray(a), np.asarray(b))
+    return a == b
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: span-tree integrity + Chrome-trace validity on a real traced run.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_run(tmp_path_factory):
+    """One traced sharded discover on the 8-device proxy, with a tiny pair
+    budget so the pass executor runs several dep-slice passes."""
+    trace_dir = str(tmp_path_factory.mktemp("trace"))
+    triples = generate_triples(300, seed=5, n_predicates=8, n_entities=32)
+    saved = os.environ.get("RDFIND_PAIR_ROW_BUDGET")
+    os.environ["RDFIND_PAIR_ROW_BUDGET"] = "4000"
+    metrics.reset()
+    tracer.start(trace_dir, host_index=0)
+    try:
+        stats: dict = {}
+        with tracer.span("run", cat=tracer.CAT_RUN):
+            with tracer.span("discover", cat=tracer.CAT_STAGE):
+                table = sharded.discover_sharded(triples, 2, mesh=make_mesh(8),
+                                                 stats=stats)
+    finally:
+        tracer.stop()
+        if saved is None:
+            os.environ.pop("RDFIND_PAIR_ROW_BUDGET", None)
+        else:
+            os.environ["RDFIND_PAIR_ROW_BUDGET"] = saved
+    path = report.export_chrome_trace(trace_dir)
+    snapshot = metrics.registry().snapshot()
+    return dict(trace_dir=trace_dir, trace_path=path, stats=stats,
+                table=table, snapshot=snapshot)
+
+
+def test_span_tree_integrity(traced_run):
+    """Every open span closes; pass spans nest under the stage span with
+    dispatch/pull children; the exchange ledger rides along as instants."""
+    events = report.load_events(
+        os.path.join(traced_run["trace_dir"], "events-host0.jsonl"))
+    assert events, "tracer wrote no events"
+    assert {e["ph"] for e in events} <= {"B", "E", "i", "C"}
+    roots, unclosed = report.build_span_tree(
+        [e for e in events if e["ph"] in "BEi"])
+    assert unclosed == [], [n["name"] for n in unclosed]
+    assert [r["name"] for r in roots] == ["run"]
+    stages = [c for c in roots[0]["children"] if c["cat"] == "stage"]
+    assert [s["name"] for s in stages] == ["discover"]
+    passes = [c for c in stages[0]["children"] if c["name"] == "pass"]
+    n_pass = traced_run["stats"]["n_pair_passes"]
+    assert len(passes) == n_pass  # one span per dep-slice pass, no retries
+    seen_child_names = set()
+    for p in passes:
+        assert p["cat"] == tracer.CAT_PASS
+        assert p["dur"] is not None and p["dur"] >= 0
+        seen_child_names |= {c["name"] for c in p["children"]}
+    assert {"dispatch", "pull-counters", "pull-blocks"} <= seen_child_names
+    # Exchange-ledger instants are children of the dispatch spans.
+    dispatches = [c for p in passes for c in p["children"]
+                  if c["name"] == "dispatch"]
+    assert any(c["name"] == "exchange" for d in dispatches
+               for c in d["children"])
+    # Every pass index 0..n_pass-1 committed exactly once.
+    assert sorted(p["args"]["pass"] for p in passes) == list(range(n_pass))
+
+
+def test_chrome_trace_json_valid(traced_run):
+    """The exported trace is well-formed Chrome-trace JSON: the object
+    format Perfetto/chrome://tracing load (traceEvents + required per-event
+    fields + per-host process_name metadata), timestamps rebased to 0."""
+    with open(traced_run["trace_path"]) as f:
+        trace = json.load(f)
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+    metas = [e for e in trace["traceEvents"] if e.get("ph") == "M"]
+    assert {m["args"]["name"] for m in metas} == {"host 0"}
+    for ev in trace["traceEvents"]:
+        assert isinstance(ev.get("name"), str)
+        assert ev.get("ph") in ("B", "E", "i", "C", "M")
+        assert isinstance(ev.get("pid"), int)
+        if ev["ph"] != "M":
+            assert isinstance(ev.get("ts"), int) and ev["ts"] >= 0
+    ts = [e["ts"] for e in trace["traceEvents"] if "ts" in e]
+    assert min(ts) == 0  # rebased
+
+
+def test_trace_annotations_emitted(tmp_path):
+    """When jax is importable the tracer pairs each span with a
+    jax.profiler.TraceAnnotation (the host/device alignment contract)."""
+    t = tracer.start(str(tmp_path), host_index=0)
+    assert t._annotation_cls is not None  # jax is present in this suite
+    with tracer.span("probe", cat=tracer.CAT_STAGE) as s:
+        assert s._annotation is not None
+    tracer.stop()
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: registry snapshot() == legacy stats, on all four strategies.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("strategy", [0, 1, 2, 3])
+def test_registry_snapshot_matches_legacy_stats(mesh8, strategy):
+    triples = generate_triples(300, seed=9, n_predicates=6, n_entities=24)
+    metrics.reset()
+    stats: dict = {}
+    STRATEGIES[strategy](triples, 2, mesh=mesh8, stats=stats, use_fis=True,
+                         use_ars=True)
+    snap = metrics.registry().snapshot()
+    assert stats, "strategy published no stats"
+    missing = [k for k in stats if k not in snap]
+    assert not missing, f"registry never saw: {missing}"
+    diverged = [k for k in stats if not _equal(stats[k], snap[k])]
+    assert not diverged, {k: (stats[k], snap[k]) for k in diverged}
+
+
+def test_prometheus_exposition(tmp_path, mesh8):
+    triples = generate_triples(150, seed=8, n_predicates=6, n_entities=24)
+    metrics.reset()
+    stats: dict = {}
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    out = tmp_path / "metrics.prom"
+    metrics.registry().write_prometheus(str(out))
+    text = out.read_text()
+    assert "rdfind_n_host_syncs" in text
+    assert 'rdfind_exchange_sites_bytes{key="exchange_c"}' in text
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert line.startswith("# TYPE rdfind_")
+        else:
+            name, value = line.rsplit(" ", 1)
+            float(value)  # every sample parses as a number
+
+
+# ---------------------------------------------------------------------------
+# Multi-host trace merge.
+# ---------------------------------------------------------------------------
+
+
+def test_multihost_trace_merge(tmp_path):
+    """Per-host event files merge into one trace with one lane per host,
+    pids forced from the file names and a shared rebased clock."""
+    for h in (0, 1):
+        t = tracer.Tracer(str(tmp_path), host_index=h, annotate=False)
+        with t.open_span("run", tracer.CAT_RUN, {}):
+            with t.open_span("discover", tracer.CAT_STAGE, {"host": h}):
+                pass
+        t.close()
+    merged = report.merge_traces(str(tmp_path))
+    evs = merged["traceEvents"]
+    assert {e["pid"] for e in evs} == {0, 1}
+    metas = [e for e in evs if e.get("ph") == "M"]
+    assert {m["args"]["name"] for m in metas} == {"host 0", "host 1"}
+    for h in (0, 1):
+        lane = [e for e in evs if e["pid"] == h and e.get("ph") in "BEi"]
+        roots, unclosed = report.build_span_tree(lane)
+        assert unclosed == []
+        assert [r["name"] for r in roots] == ["run"]
+        assert [c["name"] for c in roots[0]["children"]] == ["discover"]
+    assert min(e["ts"] for e in evs if "ts" in e) == 0
+
+
+# ---------------------------------------------------------------------------
+# HBM watermarks (driven through the test seam; CPU reports no memory).
+# ---------------------------------------------------------------------------
+
+
+def test_memory_watermarks_and_near_cap_warning(capsys):
+    readings = iter([
+        [("dev0", dict(bytes_in_use=40, peak_bytes_in_use=50,
+                       bytes_limit=100))],
+        [("dev0", dict(bytes_in_use=95, peak_bytes_in_use=96,
+                       bytes_limit=100))],
+    ])
+    memory._stats_fn = lambda: next(readings)
+    stats: dict = {}
+    rec = memory.sample(stats, label="pass 0")
+    assert rec == stats["hbm"]
+    assert rec["frac"] == 0.4 and rec["delta_bytes"] == 0
+    assert "hbm_near_cap_warnings" not in stats
+    rec = memory.sample(stats, label="pass 1")
+    assert rec["in_use_bytes"] == 95 and rec["delta_bytes"] == 55
+    assert stats["hbm_near_cap_warnings"] == 1  # crossed the 0.9 default
+    assert "HBM near cap" in capsys.readouterr().err
+    # The registry mirrors the watermark record bit-for-bit.
+    assert metrics.registry().snapshot()["hbm"] == stats["hbm"]
+    # Warn latches once per device: a third hot sample must not re-warn.
+    memory._stats_fn = lambda: [("dev0", dict(
+        bytes_in_use=97, peak_bytes_in_use=97, bytes_limit=100))]
+    memory.sample(stats, label="pass 2")
+    assert stats["hbm_near_cap_warnings"] == 1
+
+
+def test_memory_sample_noop_without_backend_stats():
+    memory._stats_fn = lambda: []
+    stats: dict = {}
+    assert memory.sample(stats) is None
+    assert stats == {}
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat: a wedged run is distinguishable from a slow one.
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_write_read_assess(tmp_path):
+    d = str(tmp_path)
+    heartbeat.write(d, {"stage": "discover", "pass": 3}, host_index=0)
+    got = heartbeat.read(d, 0)
+    assert got["stage"] == "discover" and got["pass"] == 3
+    now = got["ts"]
+    assert heartbeat.assess(d, stale_s=60, now=now + 5)["state"] == "alive"
+    verdict = heartbeat.assess(d, stale_s=60, now=now + 120)
+    assert verdict["state"] == "wedged"
+    assert verdict["hosts"][0]["stage"] == "discover"
+    assert heartbeat.assess(str(tmp_path / "nope"))["state"] == "missing"
+
+
+def test_heartbeat_final_means_done(tmp_path):
+    t = tracer.Tracer(str(tmp_path), host_index=0, annotate=False)
+    with t.open_span("run", tracer.CAT_RUN, {}):
+        pass
+    t.close()  # writes the final beat
+    assert heartbeat.assess(str(tmp_path))["state"] == "done"
+
+
+def test_tpu_watch_status_cli(tmp_path):
+    import subprocess
+    import sys
+
+    d = str(tmp_path)
+    heartbeat.write(d, {"stage": "discover", "pass": 1}, host_index=0)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "alive" in r.stdout and "discover" in r.stdout
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d,
+         "--stale-s", "0"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "wedged" in r.stdout
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status",
+         str(tmp_path / "absent")],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# Disabled-path overhead.
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_span_is_shared_noop():
+    assert not tracer.enabled()
+    s1 = tracer.span("x", cat=tracer.CAT_PASS)
+    s2 = tracer.span("y", cat=tracer.CAT_PULL, arg=1)
+    assert s1 is s2  # one shared object, no per-call allocation
+    tracer.instant("z")  # and instants are free too
+    with s1:
+        pass
+
+
+def test_disabled_span_overhead_micro():
+    """The disabled path is one global check + a shared object: bound it at
+    a generous couple of microseconds per call so a future 'cheap' feature
+    cannot quietly put real work on it (the hot path takes ~4 span/instant
+    hits per pass)."""
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with tracer.span("p", cat=tracer.CAT_PASS):
+            pass
+    per_call_us = (time.perf_counter() - t0) / n * 1e6
+    assert per_call_us < 25.0, f"{per_call_us:.2f}us per disabled span"
+
+
+def test_disabled_tracing_overhead_under_2pct(mesh8):
+    """The ISSUE 5 acceptance bound, computed from measured quantities
+    instead of a flaky A/B wall-clock race: (measured disabled-path cost per
+    obs hit) x (obs hits per pass, counted from a traced run of the same
+    executor) x n_pass must stay under 2% of the pipeline's measured wall
+    clock on the bench-tiny shape.  Deterministic on a noisy shared box —
+    both factors are measured in-process, and the per-hit cost is measured
+    under the same interpreter load as the wall clock."""
+    triples = generate_triples(300, seed=5, n_predicates=8, n_entities=32)
+    stats: dict = {}
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)  # warm
+    stats = {}
+    t0 = time.perf_counter()
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    wall_s = time.perf_counter() - t0
+
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with tracer.span("p", cat=tracer.CAT_PASS):
+            pass
+        tracer.instant("x")
+    per_hit_s = (time.perf_counter() - t0) / (2 * n)
+    # Per committed pass the executor takes <= 4 spans (pass, dispatch,
+    # 2 pulls) + 2 exchange instants; double it for shim headroom.
+    hits = 12 * max(stats.get("n_pair_passes", 1), 1)
+    overhead = hits * per_hit_s
+    assert overhead / wall_s < 0.02, (
+        f"disabled obs path costs {overhead * 1e3:.3f}ms over "
+        f"{wall_s * 1e3:.0f}ms wall ({overhead / wall_s:.2%})")

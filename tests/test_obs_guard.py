@@ -1,0 +1,47 @@
+"""Static-analysis guard: telemetry must not silently fork again.
+
+PRs 1-4 each grew their own ``stats["..."] = ...`` writes; ISSUE 5 routed
+every one of them through the sanctioned obs publish shims
+(rdfind_tpu/obs/metrics.py), which mirror the write into the process-wide
+registry.  A direct dict write would reintroduce keys the registry (and
+therefore Prometheus exposition, the bench obs snapshot, and the
+snapshot-parity test) never sees — this fast-tier grep makes that a test
+failure instead of a silent drift.
+"""
+
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "rdfind_tpu"
+
+# A stats telemetry write: subscript assignment (incl. +=) or an
+# update()/setdefault() call on a variable named `stats` (also catches
+# `self.stats[...]`).  Reads (stats.get, `in stats`, comparisons) pass.
+_WRITE = re.compile(
+    r"\bstats\s*(\[[^\]]*\]\s*(=(?!=)|\+=)|\.\s*(update|setdefault)\s*\()")
+
+
+def test_no_direct_stats_writes_outside_obs():
+    violations = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(REPO)
+        if rel.parts[1] == "obs":
+            continue  # the shims themselves live here
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if _WRITE.search(line):
+                violations.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not violations, (
+        "direct stats writes outside rdfind_tpu/obs/ (publish through "
+        "rdfind_tpu.obs.metrics shims instead):\n" + "\n".join(violations))
+
+
+def test_shims_exist():
+    """The shim surface the guard assumes must actually exist (a rename
+    would otherwise leave the guard passing while every site breaks)."""
+    from rdfind_tpu.obs import metrics
+
+    for shim in ("mutate", "counter_add", "counter_max", "gauge_set",
+                 "time_add", "set_many", "struct_set", "struct_update",
+                 "list_append", "mapping_set", "restore", "observe"):
+        assert callable(getattr(metrics, shim)), shim

@@ -17,6 +17,14 @@ Run detached:  nohup python tpu_watch.py >> tpu_watch.log 2>&1 &
 Mirrors the reference's always-reporting measurement discipline
 (AbstractFlinkProgram.java:65-77,175-182): every probe attempt and every
 outcome is logged; the watcher never exits silently.
+
+Liveness (obs integration): the watcher writes its own heartbeat/status
+file (phase, attempt, last-event timestamp) into its obs directory via
+rdfind_tpu.obs.heartbeat, so "is the watcher wedged inside a bench or just
+sleeping between probes" is answerable without reading the log.  The same
+machinery reads any RUN's obs directory back: ``tpu_watch.py --status DIR``
+prints alive/wedged/done (+ the stage/pass the run is inside) and exits
+0/1/2 — the wedged-vs-slow verdict for traced rdfind runs (--trace DIR).
 """
 
 import argparse
@@ -28,10 +36,26 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 STOP_FILE = os.path.join(REPO, "tpu_watch.stop")
+OBS_DIR = os.path.join(REPO, "tpu_watch_obs")
+
+sys.path.insert(0, REPO)
+from rdfind_tpu.obs import heartbeat  # noqa: E402 (after sys.path fix)
+
+_STATUS = {"phase": "starting", "attempt": 0}
+
+
+def beat(**status) -> None:
+    """Update + persist the watcher's own heartbeat (never fails the loop)."""
+    _STATUS.update(status)
+    try:
+        heartbeat.write(OBS_DIR, dict(_STATUS, stage=_STATUS["phase"]))
+    except Exception:
+        pass
 
 
 def log(msg: str) -> None:
     print(f"[tpu_watch {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+    beat(last_message=msg)
 
 
 def probe(timeout_s: int = 120) -> bool:
@@ -124,33 +148,70 @@ def run_benches() -> bool:
     return ok
 
 
+def report_status(obs_dir: str, stale_s: float) -> int:
+    """The wedged-vs-slow verdict over a run's obs directory (exit codes:
+    0 alive/done, 1 wedged, 2 no heartbeat at all)."""
+    verdict = heartbeat.assess(obs_dir, stale_s=stale_s)
+    state = verdict["state"]
+    if state == "missing":
+        print(f"status[{obs_dir}]: no heartbeat files "
+              f"(not a traced run directory, or the run never started)")
+        return 2
+    for h, b in sorted(verdict["hosts"].items()):
+        where = b.get("stage")
+        if b.get("pass") is not None:
+            where = f"{where} pass {b.get('pass')}"
+        print(f"status[{obs_dir}] host {h}: last event {b['age_s']}s ago "
+              f"in {where}" + (" (final)" if b.get("final") else ""))
+    print(f"status[{obs_dir}]: {state}" + (
+        f" (no span boundary for > {stale_s:.0f}s — wedged, not slow)"
+        if state == "wedged" else ""))
+    return 1 if state == "wedged" else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--deadline-h", type=float, default=10.0,
                     help="give up after this many hours")
     ap.add_argument("--interval-s", type=float, default=180.0,
                     help="sleep between failed probes")
+    ap.add_argument("--status", default=None, metavar="DIR",
+                    help="read the heartbeat files in an obs directory (a "
+                         "--trace DIR, or this watcher's own "
+                         "tpu_watch_obs/) and report alive/wedged/done "
+                         "instead of watching")
+    ap.add_argument("--stale-s", type=float,
+                    default=heartbeat.DEFAULT_STALE_S,
+                    help="--status: heartbeat age above which a run counts "
+                         "as wedged")
     args = ap.parse_args()
+    if args.status is not None:
+        return report_status(args.status, args.stale_s)
 
     deadline = time.time() + args.deadline_h * 3600
     attempt = 0
+    beat(phase="probing")
     while time.time() < deadline:
         if os.path.exists(STOP_FILE):
             log("stop file present; exiting")
             return 0
         attempt += 1
+        beat(phase="probing", attempt=attempt)
         log(f"probe attempt {attempt}")
         if probe():
+            beat(phase="benching")
             if run_benches():
                 log("TPU benches captured; exiting")
                 return 0
             log("benches incomplete on a live tunnel; retrying once more "
                 "after a short sleep")
+            beat(phase="cooldown")
             time.sleep(60)
             if probe() and run_benches():
                 log("TPU benches captured on retry; exiting")
                 return 0
             log("retry failed; going back to probing")
+        beat(phase="sleeping", attempt=attempt)
         time.sleep(args.interval_s)
     log("deadline reached without a live TPU; exiting")
     return 1
