@@ -159,28 +159,170 @@ def _measure_mfu(stats: dict, backend: str) -> dict:
         out["achieved_tflops"] = round(achieved / 1e12, 3)
     except Exception as e:  # e.g. bf16 matrix over HBM under an int8 plan
         out["bf16_error"] = f"{type(e).__name__}: {e}"
+    achieved8 = None
     try:
         # Same sweep on int8 membership (the default cooc dtype on int8-MXU
         # backends): measures the int8 path at these shapes.
         dt8 = time_sweep(jnp.int8)
-        out["int8_achieved_tops"] = round(issued / dt8 / 1e12, 3)
+        achieved8 = issued / dt8
+        out["int8_achieved_tops"] = round(achieved8 / 1e12, 3)
         if achieved is not None:
             out["int8_vs_bf16"] = round(dt / dt8, 3)
     except Exception as e:  # int8 matmul unsupported on some backends
         out["int8_error"] = f"{type(e).__name__}: {e}"
+    if backend == "tpu" and cooc.fuse_verdict_enabled():
+        # The fused-verdict kernel at the same shapes (device-only, full
+        # K-block schedule): the raw-roofline row the headline run rides.
+        try:
+            kl = plan.line_block
+            nb = l_pad // kl
+            bids = jnp.asarray(np.arange(nb, dtype=np.int32))
+            nr = jnp.asarray(np.full(1, nb, np.int32))
+            mat = jnp.asarray(member_h,
+                              jnp.int8 if plan.dtype == "int8"
+                              else jnp.bfloat16)
+
+            def fsweep():
+                outs = [cooc._fused_cind_tile(
+                    mat, jnp.int32(lo), dep_count, cap_id, cap_id, cap_id,
+                    jnp.int32(10), bids, nr, tile=tile, interpret=False)
+                    for lo in plan.dep_tile_starts]
+                jax.block_until_ready(outs)
+
+            fsweep()  # compile
+            reps = 3
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fsweep()
+            dtf = (time.perf_counter() - t0) / reps
+            out["fused_sweep_s"] = round(dtf, 4)
+            out["fused_achieved_tflops"] = round(issued / dtf / 1e12, 3)
+            # Against the unfused sweep of the SAME resolved dtype.
+            base = achieved8 if (plan.dtype == "int8" and achieved8) \
+                else achieved
+            if base:
+                out["fused_vs_unfused"] = round((issued / base) / dtf, 3)
+        except Exception as e:
+            out["fused_error"] = f"{type(e).__name__}: {e}"
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     if backend == "tpu" and gen in TPU_PEAKS:
-        peak = TPU_PEAKS[gen]["bf16_tflops"] * 1e12
+        peaks = TPU_PEAKS[gen]
+        peak = peaks["bf16_tflops"] * 1e12
         out["chip"] = gen
-        out["peak_bf16_tflops"] = TPU_PEAKS[gen]["bf16_tflops"]
+        out["peak_bf16_tflops"] = peaks["bf16_tflops"]
         if achieved is not None:
-            out["mfu"] = round(achieved / peak, 4)
-            out["mfu_corrected"] = round(achieved * plan.occupancy / peak, 4)
-        if "int8_achieved_tops" in out and "int8_tops" in TPU_PEAKS[gen]:
+            out["bf16_mfu"] = round(achieved / peak, 4)
+        if achieved8 is not None and "int8_tops" in peaks:
             out["int8_mfu"] = round(
-                out["int8_achieved_tops"] / TPU_PEAKS[gen]["int8_tops"], 4)
+                achieved8 / (peaks["int8_tops"] * 1e12), 4)
             out["int8_mfu_corrected"] = round(
                 out["int8_mfu"] * plan.occupancy, 4)
+        # The HEADLINE mfu follows the *resolved* membership dtype, against
+        # the matching MXU peak (an int8 run rated against the bf16 peak
+        # would understate utilization 2x; int4 nibble planes keep the int8
+        # membership element, so int8 is also their honest denominator) —
+        # labeled so the artifact says which roofline it is.
+        resolved = cooc.resolved_cooc_dtype()
+        if resolved == "int8" and achieved8 is not None \
+                and "int8_tops" in peaks:
+            out["peak_dtype"] = "int8"
+            out["peak_tflops"] = peaks["int8_tops"]
+            head = achieved8 / (peaks["int8_tops"] * 1e12)
+        elif achieved is not None:
+            out["peak_dtype"] = "bf16"
+            out["peak_tflops"] = peaks["bf16_tflops"]
+            head = achieved / peak
+        else:
+            head = None
+        if head is not None:
+            out["mfu"] = round(head, 4)
+            out["mfu_corrected"] = round(head * plan.occupancy, 4)
+    return out
+
+
+def _bench_kernel_modes(backend: str) -> dict:
+    """Per-mode rows for the containment/CIND kernels: plane bits x fused
+    verdict, each with an HBM watermark sample (obs/memory.py), so the
+    "fused never materializes the cooc counts" claim is a measured number.
+
+    Plane rows rerun the packed-containment selfcheck at 8- and 4-bit
+    planes (the int4 row only engages where the backend probe lowers it —
+    elsewhere it records the emulated parity run).  Fused rows run the same
+    dense CIND sweep with RDFIND_FUSE_VERDICT off/on, fused FIRST, so a
+    higher HBM peak on the materialized row is attributable to the int32
+    cooc tile the fused kernel keeps in VMEM.  On backends without memory
+    stats (CPU) the hbm field is None and, off-TPU, the fused row shrinks
+    to a tiny interpreted parity check.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from rdfind_tpu.obs import memory
+    from rdfind_tpu.ops import cooc, sketch
+
+    on_tpu = backend == "tpu"
+    out = {"modes": []}
+
+    def hbm():
+        rec = memory.sample(None, publish=False)
+        return None if rec is None else {
+            "in_use_bytes": rec["in_use_bytes"],
+            "peak_bytes": rec["peak_bytes"],
+            "delta_bytes": rec["delta_bytes"]}
+
+    saved_pb, saved_fv = cooc.PLANE_BITS, cooc.FUSE_VERDICT
+    try:
+        for pb in ("8", "4"):
+            cooc.PLANE_BITS = pb
+            row = {"mode": f"planes{pb}",
+                   "kernel_dtype": cooc.resolved_kernel_dtype()}
+            try:
+                n = 2048 if on_tpu else 256
+                row.update(sketch.kernel_selfcheck(
+                    n_rows=n, n_bits=4096, backend=backend, repeats=3))
+            except Exception as e:
+                row["error"] = f"{type(e).__name__}: {e}"
+            row["hbm"] = hbm()
+            out["modes"].append(row)
+        cooc.PLANE_BITS = saved_pb
+
+        # Fused-verdict rows share one membership matrix; the sweep is the
+        # full scheduled dep-tile pass of discover_pairs_dense.
+        rng = np.random.default_rng(7)
+        n_lines, num_caps = (100_000, 4096) if on_tpu else (300, 200)
+        plan = cooc.dense_plan(n_lines, num_caps)
+        if plan is None:
+            out["fused_error"] = "dense plan does not fit"
+            return out
+        member = rng.random((plan.l_pad, plan.c_pad)) < 0.01
+        dt = jnp.int8 if plan.dtype == "int8" else jnp.bfloat16
+        m = jax.block_until_ready(jnp.asarray(member, dt))
+        dep_count = member.sum(axis=0).astype(np.int64)
+        cap_id = rng.integers(0, 1 << 20, plan.c_pad).astype(np.int64)
+        baseline = None
+        for fv in ("1", "0"):  # fused first: see docstring
+            cooc.FUSE_VERDICT = fv
+            mode_plan = cooc.dense_plan(n_lines, num_caps)
+            stats: dict = {}
+            t0 = time.perf_counter()
+            d, r, _ = cooc.discover_pairs_dense(
+                m, dep_count, cap_id, cap_id, cap_id, 10,
+                num_caps, mode_plan.tile, starts=mode_plan.dep_tile_starts,
+                plan=mode_plan, stats=stats)
+            wall = time.perf_counter() - t0
+            pairs = set(zip(d.tolist(), r.tolist()))
+            row = {"mode": "fused" if fv == "1" else "materialized",
+                   "wall_s": round(wall, 4),
+                   "n_cinds": len(pairs),
+                   "n_blocks_skipped": stats.get("n_blocks_skipped"),
+                   "hbm": hbm()}
+            if baseline is None:
+                baseline = pairs
+            else:
+                row["outputs_identical"] = pairs == baseline
+            out["modes"].append(row)
+    finally:
+        cooc.PLANE_BITS, cooc.FUSE_VERDICT = saved_pb, saved_fv
     return out
 
 
@@ -493,6 +635,13 @@ def _run(n: int, min_support: int) -> dict:
                         big["pallas_gbps"] / TPU_PEAKS[gen]["hbm_gbps"], 4)
             except Exception as e:
                 pk["roofline_8k"] = {"error": f"{type(e).__name__}: {e}"}
+        # Per-mode rows: plane bits x fused verdict, each with an HBM
+        # watermark sample (rung-2 acceptance: the fused row's peak must
+        # undercut the materialized row's by the cooc tile it never writes).
+        try:
+            pk["modes"] = _bench_kernel_modes(backend)["modes"]
+        except Exception as e:
+            pk["modes"] = {"error": f"{type(e).__name__}: {e}"}
         detail["pallas_vs_jnp"] = pk
     except Exception as e:  # kernel comparison is best-effort
         detail["pallas_vs_jnp"] = {"error": f"{type(e).__name__}: {e}"}

@@ -44,7 +44,8 @@ CONFIGS = {
 }
 
 
-def run_one(config_id: int, strategy: int, dtype: str = "auto") -> dict:
+def run_one(config_id: int, strategy: int, dtype: str = "auto",
+            plane_bits: str = "auto", fuse: str = "auto") -> dict:
     from rdfind_tpu.models import (allatonce, approximate, late_bb,
                                    small_to_large)
     from rdfind_tpu.ops import cooc
@@ -60,8 +61,14 @@ def run_one(config_id: int, strategy: int, dtype: str = "auto") -> dict:
 
     if dtype not in ("auto", "bf16", "int8"):
         raise ValueError(f"dtype must be auto, bf16 or int8, got {dtype!r}")
-    saved = cooc.COOC_DTYPE
-    cooc.COOC_DTYPE = dtype
+    if plane_bits not in ("auto", "4", "8"):
+        raise ValueError(f"plane bits must be auto, 4 or 8, "
+                         f"got {plane_bits!r}")
+    if fuse not in ("auto", "0", "1"):
+        raise ValueError(f"fuse must be auto, 0 or 1, got {fuse!r}")
+    saved = (cooc.COOC_DTYPE, cooc.PLANE_BITS, cooc.FUSE_VERDICT)
+    cooc.COOC_DTYPE, cooc.PLANE_BITS, cooc.FUSE_VERDICT = (dtype, plane_bits,
+                                                           fuse)
     try:
         stats: dict = {}
         discover(triples, spec["min_support"], stats=stats)  # warm (compile)
@@ -70,7 +77,7 @@ def run_one(config_id: int, strategy: int, dtype: str = "auto") -> dict:
         table = discover(triples, spec["min_support"], stats=stats)
         wall = time.perf_counter() - t0
     finally:
-        cooc.COOC_DTYPE = saved
+        cooc.COOC_DTYPE, cooc.PLANE_BITS, cooc.FUSE_VERDICT = saved
 
     total_pairs = int(stats.get("total_pairs", 0))
     return {
@@ -78,6 +85,9 @@ def run_one(config_id: int, strategy: int, dtype: str = "auto") -> dict:
         "label": spec["label"],
         "strategy": strategy,
         "cooc_dtype": stats.get("cooc_dtype", dtype),
+        "plane_bits": stats.get("plane_bits"),
+        "fuse_verdict": fuse,
+        "n_blocks_skipped": stats.get("n_blocks_skipped"),
         "dense_plan": stats.get("dense_plan"),
         "wall_s": round(wall, 3),
         "total_pairs": total_pairs,
@@ -96,6 +106,12 @@ def main():
     ap.add_argument("--dtypes", default="int8,bf16",
                     help="cooc membership dtypes, one row each "
                          "(int8 | bf16 | auto)")
+    ap.add_argument("--plane-bits", default="auto",
+                    help="containment-kernel plane widths, one row each "
+                         "(8 | 4 | auto; 4 = nibble planes where the int4 "
+                         "MXU path lowers)")
+    ap.add_argument("--fuse", default="auto",
+                    help="fused-verdict modes, one row each (0 | 1 | auto)")
     args = ap.parse_args()
 
     # The axon tunnel can wedge (block inside a C call); use bench.py's
@@ -108,15 +124,21 @@ def main():
     for cid in (int(c) for c in args.configs.split(",")):
         for strat in (int(s) for s in args.strategies.split(",")):
             for dtype in args.dtypes.split(","):
-                try:
-                    row = run_one(cid, strat, dtype=dtype.strip())
-                except Exception as e:  # keep reporting the rest of the matrix
-                    row = {"config": cid, "strategy": strat,
-                           "cooc_dtype": dtype.strip(),
-                           "error": f"{type(e).__name__}: {e}"}
-                row["backend"] = backend
-                rows.append(row)
-                print(json.dumps(row), flush=True)
+                for pb in args.plane_bits.split(","):
+                    for fuse in args.fuse.split(","):
+                        try:
+                            row = run_one(cid, strat, dtype=dtype.strip(),
+                                          plane_bits=pb.strip(),
+                                          fuse=fuse.strip())
+                        except Exception as e:  # keep reporting the rest
+                            row = {"config": cid, "strategy": strat,
+                                   "cooc_dtype": dtype.strip(),
+                                   "plane_bits": pb.strip(),
+                                   "fuse_verdict": fuse.strip(),
+                                   "error": f"{type(e).__name__}: {e}"}
+                        row["backend"] = backend
+                        rows.append(row)
+                        print(json.dumps(row), flush=True)
 
     print(f"{'cfg':>3} {'strat':>5} {'dtype':>5} {'wall_s':>9} "
           f"{'Mpairs/s':>9} {'cinds':>8}", file=sys.stderr)

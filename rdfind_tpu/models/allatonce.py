@@ -371,8 +371,13 @@ def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
     if stats is not None:
         metrics.struct_set(stats, "dense_plan", plan.describe())
         metrics.gauge_set(stats, "cooc_dtype", plan.dtype)
+        metrics.gauge_set(stats, "plane_bits", plan.plane_bits)
+        metrics.gauge_set(stats, "fuse_verdict", plan.fuse_verdict)
 
-    if c_pad <= SINGLE_SHOT_C:
+    # The fused-verdict sweep always runs tiled (its kernel is the tile
+    # dispatch); the one-dispatch single-shot program is the materialized
+    # path's latency optimization.
+    if c_pad <= SINGLE_SHOT_C and not plan.fuse_verdict:
         packed, dep_count, lens, n_bits = _stage_dense_all(
             line_gid, cap_id, cand_valid, jnp.int32(min_support),
             cap_code, cap_v1, cap_v2, l_pad=l_pad, c_pad=c_pad,
@@ -406,7 +411,8 @@ def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
         dep_id, ref_id, support = cooc.discover_pairs_dense(
             m, dep_count, _fit_device(cap_code, c_pad),
             _fit_device(cap_v1, c_pad), _fit_device(cap_v2, c_pad),
-            min_support, num_caps, tile, starts=plan.dep_tile_starts)
+            min_support, num_caps, tile, starts=plan.dep_tile_starts,
+            plan=plan, stats=stats)
         (code_h, v1_h, v2_h, dep_count_h) = jax.device_get(
             (cap_code[:num_caps], cap_v1[:num_caps], cap_v2[:num_caps],
              jax.lax.slice(dep_count, (0,), (num_caps,))))

@@ -862,11 +862,15 @@ class _Pipeline:
             metrics.struct_set(stats, "planned_caps",
                                dict(self._planned_caps))
             # The sketch/containment stages (sharded strategies 2/3) contract
-            # in the resolved cooc dtype; record it for bench/debug parity
-            # with the single-chip strategies.
+            # in the resolved cooc dtype — and, on the packed Pallas kernel,
+            # at the resolved plane width (int4 nibble planes double the
+            # K-dim per MXU pass); record both for bench/debug parity with
+            # the single-chip strategies.
             from ..ops import cooc as cooc_ops
             metrics.gauge_set(stats, "cooc_dtype",
                               cooc_ops.resolved_cooc_dtype())
+            metrics.gauge_set(stats, "plane_bits",
+                              cooc_ops.resolved_plane_bits())
 
     def _maybe_rebalance(self):
         """Greedy least-loaded reassignment of hot lines (the reference's

@@ -447,7 +447,8 @@ def _prepare_dense(padded, n, min_support, projections, use_fc_filter, use_ars,
             n_captures=num_caps, total_pairs=0,
             max_line=int(lens64.max()) if lens64.size else 0,
             pair_backend="matmul",
-            dense_plan=plan.describe(), cooc_dtype=plan.dtype)
+            dense_plan=plan.describe(), cooc_dtype=plan.dtype,
+            plane_bits=plan.plane_bits)
     fn = _DenseCooc(m, cooc_m, dep_count_d, c_pad, n_lines, num_caps)
     return (fn, cap_code.astype(np.int64), cap_v1.astype(np.int64),
             cap_v2.astype(np.int64), dep_count.astype(np.int64), num_caps)

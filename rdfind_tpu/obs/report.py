@@ -192,10 +192,14 @@ def format_debug_lines(stats: dict) -> list[str]:
         lines.append(
             f"dense plan: dtype={stats.get('cooc_dtype')} "
             f"policy={dp['policy']} "
+            f"planes={dp.get('plane_bits', 8)}b "
+            f"fused={1 if dp.get('fuse_verdict') else 0} "
             f"lines={dp['l_real']}/{dp['l_pad']} "
             f"caps={dp['c_real']}/{dp['c_pad']} tile={dp['tile']} "
             f"tiles={dp['n_tiles'] - dp['n_tiles_skipped']}"
-            f"/{dp['n_tiles']} occupancy={dp['occupancy']}")
+            f"/{dp['n_tiles']} "
+            f"blocks_skipped={dp.get('n_blocks_skipped', 0)}"
+            f"/{dp.get('n_blocks', 0)} occupancy={dp['occupancy']}")
     elif "cooc_dtype" in stats:
         lines.append(f"cooc dtype: {stats['cooc_dtype']}")
     if "n_host_syncs" in stats:

@@ -69,6 +69,48 @@ if COOC_DTYPE not in ("auto", "bf16", "int8"):
 TILE_SCHEDULE = os.environ.get("RDFIND_TILE_SCHEDULE", "1").lower() \
     not in ("0", "false", "no")
 
+# Membership-plane width of the packed containment kernel
+# (ops/pallas_kernels.py).  "auto" (default) resolves to 4 — nibble-packed
+# int4 planes, doubling the K-dim each MXU pass covers at the same VMEM
+# budget — only where the backend's int4 matmul path both lowers and pays
+# off (the TPU MXU; the probe mirrors _int8_pays_off), and to 8 everywhere
+# else, so non-TPU backends keep today's behavior untouched.  "8" pins the
+# PR-2 int8 planes unconditionally; "4" forces the nibble-WK mode (on
+# backends without native int4 elements it runs with int8 elements — the
+# same doubled-WK grid, bit-identical, for differential testing).  Exactness
+# is unchanged in every mode: planes are 0/1, accumulation stays int32.
+PLANE_BITS = os.environ.get("RDFIND_PLANE_BITS", "auto")
+if PLANE_BITS not in ("auto", "4", "8"):
+    raise ValueError(f"RDFIND_PLANE_BITS must be auto, 4 or 8, "
+                     f"got {PLANE_BITS!r}")
+
+# Fused verdict + minimality pre-filter on the dense CIND sweep: compute
+# `cooc == support`, the support/diagonal masks, and the trivially-implied
+# pair rule inside the Pallas kernel epilogue, so the int32 cooc count
+# matrix lives only in VMEM scratch and never lands in HBM.  "auto"
+# (default) engages on the TPU backend only (the kernel would run in the
+# slow interpreter elsewhere, so the CPU proxy keeps the XLA path and its
+# wall clock cannot regress); RDFIND_FUSE_VERDICT=0 restores the
+# materialized cooc_cind_tile path, =1 forces the fused kernel (interpreted
+# off-TPU — the differential-test configuration).
+FUSE_VERDICT = os.environ.get("RDFIND_FUSE_VERDICT", "auto")
+if FUSE_VERDICT not in ("auto", "0", "1"):
+    raise ValueError(f"RDFIND_FUSE_VERDICT must be auto, 0 or 1, "
+                     f"got {FUSE_VERDICT!r}")
+
+# Sub-tile sparsity skipping: per-(dep-tile x line-block) membership
+# popcounts drive the dense sweep schedule — dep tiles whose captures occur
+# in no line are dropped outright (both backends), and the fused kernel's
+# K-step schedule visits only the nonzero line blocks of each dep tile
+# (scalar-prefetched block ids).  Costs one small block-count reduction +
+# host pull per sweep; RDFIND_BLOCK_SKIP=0 restores the dense full-range
+# schedule, =1 forces it (default "auto" = on whenever the plan has more
+# than one block or tile to skip).
+BLOCK_SKIP = os.environ.get("RDFIND_BLOCK_SKIP", "auto")
+if BLOCK_SKIP not in ("auto", "0", "1"):
+    raise ValueError(f"RDFIND_BLOCK_SKIP must be auto, 0 or 1, "
+                     f"got {BLOCK_SKIP!r}")
+
 # Row padding granule of the membership matrix under the tile schedule: a
 # multiple of every dtype's sublane tile (f32 8, bf16 16, int8 32) with
 # enough slack that distinct tiny test datasets still bucket together.
@@ -112,6 +154,76 @@ def resolved_cooc_dtype() -> str:
     return "int8" if _int8_pays_off() else "bf16"
 
 
+@functools.lru_cache(maxsize=1)
+def int4_matmul_supported() -> bool:
+    """One-time runtime probe: does this backend lower an int4 x int4 matmul
+    with int32 accumulation?  XLA CPU rejects sub-byte element conversions
+    outright (probed, not assumed — the _repeat_is_tile discipline), so the
+    nibble-plane mode emulates with int8 elements there."""
+    try:
+        a = jnp.ones((8, 8), jnp.int4)
+        out = jax.lax.dot_general(a, a, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        return bool(jax.device_get(out)[0, 0] == 8)
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=1)
+def _int4_pays_off() -> bool:
+    """Whether "auto" plane bits resolve to 4: the int4 matmul must lower
+    AND the backend must have a hardware sub-byte MXU path worth taking —
+    the same backend gate as _int8_pays_off (XLA CPU emulates sub-byte
+    types poorly where it supports them at all)."""
+    return jax.default_backend() == "tpu" and int4_matmul_supported()
+
+
+def int4_elements_native() -> bool:
+    """Whether jnp.int4 planes can actually live in VMEM on this backend.
+    Where they cannot, the nibble-WK mode keeps its doubled K-step grid but
+    unpacks to int8 elements — bit-identical, differential-testable."""
+    return _int4_pays_off()
+
+
+def resolved_plane_bits() -> int:
+    """Plane width of the packed containment kernel (4 or 8).
+
+    Reads PLANE_BITS at call time (tests monkeypatch the module attribute);
+    only the backend probe behind "auto" is cached.  Only meaningful when
+    the resolved cooc dtype is int8 — the bf16 fallback keeps 16-bit
+    planes."""
+    if PLANE_BITS != "auto":
+        return int(PLANE_BITS)
+    return 4 if _int4_pays_off() else 8
+
+
+def resolved_kernel_dtype() -> str:
+    """Unpack dtype of the packed Pallas containment kernel: the resolved
+    cooc dtype, narrowed to "int4" when the nibble-plane mode is in effect.
+    The jnp planes fallback keeps the plain cooc dtype (XLA has no portable
+    sub-byte contraction); both are exact and bit-identical."""
+    dtype = resolved_cooc_dtype()
+    if dtype == "int8" and resolved_plane_bits() == 4:
+        return "int4"
+    return dtype
+
+
+def fuse_verdict_enabled() -> bool:
+    """Whether the dense CIND sweep runs the fused verdict kernel.  Reads
+    FUSE_VERDICT at call time (tests monkeypatch the module attribute)."""
+    if FUSE_VERDICT != "auto":
+        return FUSE_VERDICT == "1"
+    return jax.default_backend() == "tpu"
+
+
+def block_skip_enabled() -> bool:
+    """Whether the dense sweep schedules around all-zero membership blocks.
+    Reads BLOCK_SKIP at call time (tests monkeypatch the module attribute)."""
+    if BLOCK_SKIP != "auto":
+        return BLOCK_SKIP == "1"
+    return True
+
+
 def round_up(n: int, mult: int) -> int:
     """Smallest multiple of `mult` >= max(n, 1)."""
     return -(-max(int(n), 1) // mult) * mult
@@ -129,6 +241,18 @@ def tile_for(c_pad: int, tile_max: int = DEFAULT_TILE) -> int:
     m = c_pad // CAP_MULT
     t = CAP_MULT * (m & -m)  # largest pow2 divisor of m, in columns
     return max(CAP_MULT, min(t, tile_max, c_pad))
+
+
+def line_block_for(l_pad: int, cap: int = 1024) -> int:
+    """K-step line-block granule of the fused sweep: the largest pow2
+    multiple of LINE_MULT dividing `l_pad`, capped at `cap` rows (a block's
+    two operand tiles then stay well inside VMEM); legacy pow2 plans below
+    the row granule run as one block.  Divisibility keeps every block start
+    exact — the same contract tile_for enforces on the capture axis."""
+    if l_pad % LINE_MULT:
+        return l_pad
+    m = l_pad // LINE_MULT
+    return min(LINE_MULT * (m & -m), cap)
 
 
 def cap_pad(num_caps: int, mult: int = CAP_MULT) -> int:
@@ -156,6 +280,15 @@ class DensePlan:
     n_lines: int
     num_caps: int
     dtype: str
+    # Raw-roofline rungs (ISSUE 6): resolved containment-kernel plane width,
+    # whether the verdict sweep fuses (cooc counts stay in VMEM scratch),
+    # the K-step line-block granule, and the data-driven block-skip record
+    # (filled by discover_pairs_dense via dataclasses.replace once the
+    # membership popcounts are known — shape planning alone cannot know it).
+    plane_bits: int = 8
+    fuse_verdict: bool = False
+    line_block: int = 0
+    n_blocks_skipped: int = 0
 
     def __iter__(self):  # legacy (l_pad, c_pad, tile) unpacking
         return iter((self.l_pad, self.c_pad, self.tile))
@@ -173,6 +306,16 @@ class DensePlan:
     @property
     def n_tiles_skipped(self) -> int:
         return self.n_tiles - len(self.dep_tile_starts)
+
+    @property
+    def n_line_blocks(self) -> int:
+        return self.l_pad // self.line_block if self.line_block else 0
+
+    @property
+    def n_blocks(self) -> int:
+        """(scheduled dep tile x line block) pairs the full-range sweep
+        would visit — the denominator of the block-skip accounting."""
+        return self.n_line_blocks * len(self.dep_tile_starts)
 
     @property
     def issued_flops(self) -> int:
@@ -194,11 +337,16 @@ class DensePlan:
         return {
             "policy": "tile" if TILE_SCHEDULE else "pow2",
             "dtype": self.dtype,
+            "plane_bits": self.plane_bits,
+            "fuse_verdict": self.fuse_verdict,
             "l_real": self.n_lines, "l_pad": self.l_pad,
             "c_real": self.num_caps, "c_pad": self.c_pad,
             "tile": self.tile,
             "n_tiles": self.n_tiles,
             "n_tiles_skipped": self.n_tiles_skipped,
+            "line_block": self.line_block,
+            "n_blocks": self.n_blocks,
+            "n_blocks_skipped": self.n_blocks_skipped,
             "issued_flops": self.issued_flops,
             "real_flops": self.real_flops,
             "occupancy": round(self.occupancy, 4),
@@ -258,7 +406,10 @@ def dense_plan(n_lines: int, num_caps: int, tile: int = DEFAULT_TILE):
     if l_pad * c_pad * elem_bytes > DENSE_M_BUDGET_BYTES:
         return None
     return DensePlan(l_pad=l_pad, c_pad=c_pad, tile=tile, n_lines=n_lines,
-                     num_caps=num_caps, dtype=dtype)
+                     num_caps=num_caps, dtype=dtype,
+                     plane_bits=resolved_plane_bits(),
+                     fuse_verdict=fuse_verdict_enabled(),
+                     line_block=line_block_for(l_pad))
 
 
 @functools.partial(jax.jit, static_argnames=("l_pad", "c_pad", "dtype"))
@@ -318,6 +469,74 @@ def cooc_cind_tile(m, dep_lo, dep_count, cap_code, cap_v1, cap_v2,
         cc.first_subcapture(d_code) == r_code,
         cap_v1[None, :] == d_v1, cap_v1[None, :] == d_v2)
     return pack_bool(is_cind & ~implied)
+
+
+@functools.partial(jax.jit, static_argnames=("kl", "tile"))
+def _stage_block_counts(m, *, kl: int, tile: int):
+    """(l_pad//kl, c_pad//tile) int32 membership popcounts per
+    (line-block x dep-tile) pair — the skew record driving the sub-tile
+    skip schedule (the same per-line popcounts the join-line rebalancer
+    reads for skew, here reduced at block granularity on device)."""
+    l_pad, c_pad = m.shape
+    acc = jnp.int32 if m.dtype == jnp.int8 else jnp.float32
+    blocks = m.reshape(l_pad // kl, kl, c_pad // tile, tile)
+    return blocks.sum(axis=(1, 3), dtype=acc).astype(jnp.int32)
+
+
+def _fused_ref_chunk(c_pad: int, cap: int = 16384) -> int:
+    """Ref-axis chunk of one fused kernel dispatch: bounds the transient
+    uint8 verdict block (tile x chunk) while the packed output stays
+    c_pad/8 bytes per row.  Divides c_pad by the tile_for contract."""
+    return tile_for(c_pad, cap)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _fused_cind_tile(m, dep_lo, dep_count, cap_code, cap_v1, cap_v2,
+                     min_support, block_ids, n_real, *, tile: int,
+                     interpret: bool):
+    """One (tile x c_pad) CIND block via the fused Pallas kernel.
+
+    Same packed-bitmap contract as cooc_cind_tile, computed without ever
+    writing the int32 cooc count matrix to HBM: the kernel accumulates each
+    (128 x 128) count block in VMEM scratch and emits the verdict (CIND
+    test + support filter + diagonal + trivially-implied mask, the
+    _stage_merge semantics) plus the per-dep referenced-set popcount.  The
+    K (line) dimension walks only the scalar-prefetched `block_ids`
+    (padded entries are compute-guarded), which is where the sub-tile
+    sparsity skip happens.  Returns (packed, popc, count): popc is the
+    (tile, 1) per-dep CIND count the minimality/extraction stages size
+    with, count its scalar sum — callers skip the separate packed_count
+    dispatch over the bitmap.
+    """
+    from . import pallas_kernels
+
+    c_pad = m.shape[1]
+    rc = _fused_ref_chunk(c_pad)
+    dep_count = jnp.asarray(dep_count, jnp.int32)
+    code32 = jnp.asarray(cap_code, jnp.int32)
+    v1_32 = jnp.asarray(cap_v1, jnp.int32)
+    v2_32 = jnp.asarray(cap_v2, jnp.int32)
+
+    m_tile = jax.lax.dynamic_slice(m, (0, dep_lo), (m.shape[0], tile))
+    col = lambda a: jax.lax.dynamic_slice(a, (dep_lo,), (tile,)) \
+        .reshape(tile, 1)
+    sup_col = col(dep_count)
+    ok_col = (sup_col >= jnp.int32(min_support)).astype(jnp.int32)
+    gid_col = dep_lo + jnp.arange(tile, dtype=jnp.int32).reshape(tile, 1)
+    ridx = jnp.arange(c_pad, dtype=jnp.int32).reshape(1, c_pad)
+
+    packed_chunks, popc = [], None
+    for rlo in range(0, c_pad, rc):
+        verdict, pc = pallas_kernels.fused_cind_blocks(
+            m_tile, m, sup_col, ok_col, gid_col, col(code32), col(v1_32),
+            col(v2_32), ridx, code32.reshape(1, c_pad),
+            v1_32.reshape(1, c_pad), block_ids, n_real, ref_lo=rlo,
+            ref_chunk=rc, interpret=interpret)
+        packed_chunks.append(pack_bool(verdict))
+        popc = pc if popc is None else popc + pc
+    packed = (packed_chunks[0] if len(packed_chunks) == 1
+              else jnp.concatenate(packed_chunks, axis=1))
+    return packed, popc, popc.sum(dtype=jnp.int32)
 
 
 def _inbounds(packed, rows, cols):
@@ -444,7 +663,7 @@ def extract_packed_iter(thunks, tile_bits: int):
     strategy 2's candidate generation.
     """
     if tile_bits > EXTRACT_DEVICE_ELEMS:
-        return [extract_packed(*t()) for t in thunks]
+        return [extract_packed(*t()[:3]) for t in thunks]
     out = [None] * len(thunks)
     pipelined = not dispatch.sync_passes_forced() and len(thunks) > 1
     batch = max(1, EXTRACT_DEVICE_ELEMS // tile_bits // (2 if pipelined
@@ -452,9 +671,16 @@ def extract_packed_iter(thunks, tile_bits: int):
     empty = (np.zeros(0, np.int64), np.zeros(0, np.int64))
 
     def launch(lo):
-        group = [(lo + j, *t()) for j, t in enumerate(thunks[lo:lo + batch])]
-        counts = [packed_count(p, jnp.int32(r), jnp.int32(c))
-                  for _, p, r, c in group]
+        # A thunk may return a 4th element: the tile's set-bit count already
+        # computed on device (the fused kernel's per-dep popcount summed),
+        # which replaces the separate packed_count pass over the bitmap.
+        group, counts = [], []
+        for j, t in enumerate(thunks[lo:lo + batch]):
+            res = t()
+            p, r, c = res[:3]
+            group.append((lo + j, p, r, c))
+            counts.append(res[3] if len(res) > 3 else
+                          packed_count(p, jnp.int32(r), jnp.int32(c)))
         dispatch.stage_to_host(counts)
         return group, counts
 
@@ -504,7 +730,8 @@ def unpack_cind_bits(packed: np.ndarray, c_pad: int) -> np.ndarray:
 
 
 def discover_pairs_dense(m, dep_count, cap_code, cap_v1, cap_v2, min_support,
-                         num_caps: int, tile: int, starts=None):
+                         num_caps: int, tile: int, starts=None, plan=None,
+                         stats=None):
     """Run the tiled cooc pass; return (dep_id, ref_id, support) numpy arrays.
 
     m: (l_pad, c_pad) device membership matrix.  The host loops over the
@@ -514,8 +741,20 @@ def discover_pairs_dense(m, dep_count, cap_code, cap_v1, cap_v2, min_support,
     tile popcounts, one batched pull of the sized nonzeros — only the
     set-bit index pairs ever reach the host (same two-phase decode as
     extract_packed, batched across tiles).
+
+    Under the fused-verdict policy (`plan.fuse_verdict` /
+    fuse_verdict_enabled) each tile runs the fused Pallas kernel instead of
+    the materialized cooc_cind_tile, and its in-kernel popcount replaces the
+    packed_count dispatch.  With block skipping on, per-(dep-tile x
+    line-block) membership popcounts prune the schedule first: dep tiles
+    whose captures occur in no line are dropped outright (both backends),
+    and the fused kernel's K steps visit only each tile's nonzero line
+    blocks.  `stats` (via the obs shims) records the skip accounting into
+    the dense_plan struct.
     """
-    c_pad = m.shape[1]
+    import math
+
+    l_pad, c_pad = m.shape
     dep_count_d = jnp.asarray(dep_count, jnp.int32)
     code_d = jnp.asarray(cap_code, jnp.int32)
     v1_d = jnp.asarray(cap_v1, jnp.int32)
@@ -525,12 +764,73 @@ def discover_pairs_dense(m, dep_count, cap_code, cap_v1, cap_v2, min_support,
     los = list(starts) if starts is not None else list(range(0, num_caps,
                                                              tile))
 
+    kl = (plan.line_block if plan is not None and plan.line_block
+          else line_block_for(l_pad))
+    n_line_blocks = l_pad // kl
+    from . import pallas_kernels
+
+    fused = (plan.fuse_verdict if plan is not None else fuse_verdict_enabled())
+    fused = fused and tile % 128 == 0 and c_pad % 128 == 0 \
+        and l_pad % kl == 0 and l_pad % 8 == 0 \
+        and pallas_kernels.scalar_prefetch_available()
+    interp = jax.default_backend() != "tpu"
+
+    # Sub-tile skip schedule: one small device reduction + host pull of the
+    # (n_line_blocks x n_tiles) popcount grid, amortized against the sweep.
+    block_counts = None
+    if block_skip_enabled() and l_pad % kl == 0 and c_pad % tile == 0 \
+            and (n_line_blocks > 1 or len(los) > 1):
+        block_counts = np.asarray(_stage_block_counts(m, kl=kl, tile=tile))
+    n_blocks_skipped = n_tiles_data_skipped = 0
+    tile_blocks = {}
+    if block_counts is not None:
+        kept = []
+        for lo in los:
+            col = block_counts[:, lo // tile]
+            nz = np.flatnonzero(col).astype(np.int32)
+            if nz.size == 0:
+                # All-zero dep tile: its captures occur in no line, so no
+                # verdict bit can set — drop it from the schedule entirely.
+                n_tiles_data_skipped += 1
+                n_blocks_skipped += n_line_blocks
+                continue
+            kept.append(lo)
+            if fused:
+                tile_blocks[lo] = nz
+                n_blocks_skipped += n_line_blocks - nz.size
+        los = kept
+    if stats is not None:
+        from ..obs import metrics
+        metrics.gauge_set(stats, "n_blocks_skipped", n_blocks_skipped)
+        metrics.struct_update(stats, "dense_plan",
+                              n_blocks_skipped=n_blocks_skipped,
+                              n_tiles_data_skipped=n_tiles_data_skipped)
+
     def make(lo):
         return lambda: (cooc_cind_tile(m, jnp.int32(lo), dep_count_d, code_d,
                                        v1_d, v2_d, ms, tile=tile),
                         min(num_caps - lo, tile), num_caps)
 
-    pairs = extract_packed_iter([make(lo) for lo in los], tile * c_pad)
+    def make_fused(lo):
+        nz = tile_blocks.get(lo)
+        if nz is None:
+            nz = np.arange(n_line_blocks, dtype=np.int32)
+        # Bucket the K grid to a pow2 so retraces stay logarithmic in the
+        # block count; padded steps fetch block 0 and are compute-guarded.
+        bucket = 1 << max(0, math.ceil(math.log2(nz.size)))
+        bids = jnp.asarray(np.pad(nz, (0, bucket - nz.size)))
+        nr = jnp.asarray(np.full(1, nz.size, np.int32))
+
+        def thunk():
+            packed, _, count = _fused_cind_tile(
+                m, jnp.int32(lo), dep_count_d, code_d, v1_d, v2_d, ms,
+                bids, nr, tile=tile, interpret=interp)
+            return packed, min(num_caps - lo, tile), num_caps, count
+
+        return thunk
+
+    pairs = extract_packed_iter(
+        [(make_fused if fused else make)(lo) for lo in los], tile * c_pad)
     deps = [d + lo for lo, (d, _) in zip(los, pairs) if d.size]
     refs = [r for _, (d, r) in zip(los, pairs) if d.size]
     dep_id = np.concatenate(deps) if deps else np.zeros(0, np.int64)

@@ -22,6 +22,13 @@ MXU-rate notes (the round-6 rework):
     TFLOPS).  `unpack_dtype="bf16"` keeps the old formulation for backends
     whose MXU has no int8 path — both modes are bit-exact vs the jnp planes
     path (counts are small integers either way).
+  * **int4 nibble planes** (`unpack_dtype="int4"`, the round-7 rung via
+    RDFIND_PLANE_BITS) halve the element again: WK widens to 512 words =
+    16384 contraction lanes per K step, so each MXU pass covers twice
+    int8's K-dim at the same VMEM budget.  Accumulation stays int32 (still
+    exact); backends without native int4 elements run the same widened-WK
+    grid with int8 elements (bit-identical — the emulation the CPU parity
+    tests exercise, since XLA CPU rejects sub-byte conversion outright).
   * the **dep-tile unpack is hoisted out of the ref-tile grid dimension**:
     the ref (j) dimension revisits the same dep tile nj times, so the shifted
     planes are computed once at j == 0 into a persistent VMEM scratch and
@@ -64,11 +71,21 @@ TILE_R = 128
 # (TILE, WK*32) elements in VMEM, so int8's 1-byte planes afford twice the
 # chunk of bf16 at the same budget (256 words = 8192 contraction lanes = 1 MB
 # per int8 operand tile) — larger K-step DMAs, longer MXU contractions.
-WK_MAX = {"int8": 256, "bf16": 128}
+# int4 nibble planes (RDFIND_PLANE_BITS=4) halve the element again: 512
+# words = 16384 contraction lanes per step, so each MXU pass covers twice
+# int8's K-dim at the same VMEM budget.  Exactness is untouched — planes
+# are 0/1 in every width and accumulation stays int32.
+WK_MAX = {"int4": 512, "int8": 256, "bf16": 128}
+# Bits per unpacked plane element, keyed by unpack dtype (the VMEM/hoist
+# budget arithmetic; int4 planes may fall back to int8 *elements* on
+# backends without native sub-byte support — see _plane_elem — but keep
+# their widened WK grid either way).
+PLANE_ELEM_BITS = {"int4": 4, "int8": 8, "bf16": 16}
 # VMEM budget for the hoisted full-width dep planes (TILE_D x bits x elem
-# bytes).  4 MB covers bits <= 32768 in int8 / 16384 in bf16 and leaves the
-# double-buffered operand tiles + accumulator well inside the ~16 MB core
-# budget; wider sketches fall back to the per-step unpack.
+# bytes).  4 MB covers bits <= 65536 in int4 / 32768 in int8 / 16384 in
+# bf16 and leaves the double-buffered operand tiles + accumulator well
+# inside the ~16 MB core budget; wider sketches fall back to the per-step
+# unpack.
 HOIST_PLANE_BUDGET = 4 << 20
 
 
@@ -99,12 +116,35 @@ def _repeat_is_tile() -> bool:
 
 
 def _default_unpack_dtype() -> str:
-    """The resolved cooc dtype: int8 wherever the backend's int8 matmul path
-    pays off (the cooc probes), bf16 elsewhere or when pinned via
-    RDFIND_COOC_DTYPE — one policy for every containment/cooc contraction."""
+    """The resolved kernel dtype: int4 nibble planes where the plane-bits
+    policy engages, else int8 wherever the backend's int8 matmul path pays
+    off (the cooc probes), bf16 elsewhere or when pinned via
+    RDFIND_COOC_DTYPE — one policy for every containment contraction."""
     from . import cooc
 
-    return cooc.resolved_cooc_dtype()
+    return cooc.resolved_kernel_dtype()
+
+
+def _plane_elem(dtype: str) -> str:
+    """Resolved element type the planes are actually stored/contracted in.
+
+    "int4" planes use native jnp.int4 elements only where the backend's
+    int4 matmul lowers (cooc.int4_elements_native probe); elsewhere the
+    nibble mode keeps its doubled-WK grid but stores int8 elements — the
+    arithmetic is identical (0/1 planes, int32 accumulation), so outputs
+    are bit-identical and the mode stays differential-testable on CPU,
+    whose XLA rejects sub-byte conversions outright.  The result is a
+    STATIC jit key alongside unpack_dtype: a probe flip must retrace."""
+    if dtype == "int4":
+        from . import cooc
+
+        return "int4" if cooc.int4_elements_native() else "int8"
+    return dtype
+
+
+_PLANE_JNP = {"bf16": jnp.bfloat16, "int8": jnp.int8}
+if hasattr(jnp, "int4"):
+    _PLANE_JNP["int4"] = jnp.int4
 
 
 def _repeat32(x):
@@ -114,8 +154,8 @@ def _repeat32(x):
     return pltpu.repeat(x, 32, axis=1)
 
 
-def _unpack_tile(x, dtype: str, tile_order: bool):
-    """(TILE, WK) packed uint32 -> (TILE, WK*32) 0/1 planes in `dtype`.
+def _unpack_tile(x, plane_dt, tile_order: bool):
+    """(TILE, WK) packed uint32 -> (TILE, WK*32) 0/1 planes in `plane_dt`.
 
     Lane j of the result is bit (j // WK) of word (j % WK) under tile-order
     repeat, or bit (j % 32) of word (j // 32) under repeat-order — either is
@@ -131,11 +171,11 @@ def _unpack_tile(x, dtype: str, tile_order: bool):
     shifts = (jax.lax.div(lane, jnp.uint32(wk)) if tile_order
               else jax.lax.rem(lane, jnp.uint32(32)))
     bits = ((rep >> shifts) & jnp.uint32(1)).astype(jnp.int32)
-    return bits.astype(jnp.int8 if dtype == "int8" else jnp.bfloat16)
+    return bits.astype(plane_dt)
 
 
 def _contains_kernel(s_ref, r_ref, popc_ref, out_ref, s_plane_ref, acc_ref, *,
-                     nk: int, wk: int, dtype: str, tile_order: bool,
+                     nk: int, wk: int, plane_dt, tile_order: bool,
                      hoist: bool, acc_dt):
     """One (TILE_D, TILE_R) tile of the containment matrix.
 
@@ -164,12 +204,13 @@ def _contains_kernel(s_ref, r_ref, popc_ref, out_ref, s_plane_ref, acc_ref, *,
 
         @pl.when(j == 0)
         def _fill():
-            s_plane_ref[:, chunk] = _unpack_tile(s_ref[:], dtype, tile_order)
+            s_plane_ref[:, chunk] = _unpack_tile(s_ref[:], plane_dt,
+                                                 tile_order)
 
         s_b = s_plane_ref[:, chunk]
     else:
-        s_b = _unpack_tile(s_ref[:], dtype, tile_order)
-    r_b = _unpack_tile(r_ref[:], dtype, tile_order)
+        s_b = _unpack_tile(s_ref[:], plane_dt, tile_order)
+    r_b = _unpack_tile(r_ref[:], plane_dt, tile_order)
     acc_ref[:] += jax.lax.dot_general(
         s_b, r_b, (((1,), (1,)), ((), ())),
         preferred_element_type=acc_dt)
@@ -195,22 +236,24 @@ def packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
     if unpack_dtype is None:
         unpack_dtype = _default_unpack_dtype()
     if unpack_dtype not in WK_MAX:
-        raise ValueError(f"unpack_dtype must be int8 or bf16, "
+        raise ValueError(f"unpack_dtype must be int4, int8 or bf16, "
                          f"got {unpack_dtype!r}")
-    # The pltpu.repeat lane-order probe keys the jit cache: a monkeypatched
-    # or version-dependent flip must retrace the kernel, not reuse the other
-    # order's program.
+    # The pltpu.repeat lane-order probe keys the jit cache, and so does the
+    # resolved plane element type (PR-2's static-key discipline extended to
+    # plane width): a monkeypatched or version-dependent flip must retrace
+    # the kernel, not reuse the other order's program.
     return _packed_contains_matrix(sketch_packed, ref_packed, ref_popc,
                                    interpret=interpret,
                                    unpack_dtype=unpack_dtype,
+                                   plane_elem=_plane_elem(unpack_dtype),
                                    tile_order=_repeat_is_tile())
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "unpack_dtype",
-                                             "tile_order"))
+                                             "plane_elem", "tile_order"))
 def _packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
                             interpret: bool, unpack_dtype: str,
-                            tile_order: bool):
+                            plane_elem: str, tile_order: bool):
     d, w = sketch_packed.shape
     r = ref_packed.shape[0]
     wk = min(w, WK_MAX[unpack_dtype])
@@ -218,12 +261,15 @@ def _packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
         raise ValueError(f"shapes must be tile-aligned, got D={d} R={r} W={w}")
     nk = w // wk
     grid = (d // TILE_D, r // TILE_R, nk)
-    elem = 1 if unpack_dtype == "int8" else 2
-    plane_dt = jnp.int8 if unpack_dtype == "int8" else jnp.bfloat16
-    acc_dt = jnp.int32 if unpack_dtype == "int8" else jnp.float32
-    hoist = TILE_D * w * 32 * elem <= HOIST_PLANE_BUDGET
+    # Budget arithmetic follows the unpack *mode* (int4 plans for nibble
+    # VMEM even when elements emulate as int8 — the WK grid must not depend
+    # on the emulation fallback or the two would compile different K steps).
+    elem_bits = PLANE_ELEM_BITS[unpack_dtype]
+    plane_dt = _PLANE_JNP.get(plane_elem, jnp.int8)
+    acc_dt = jnp.float32 if unpack_dtype == "bf16" else jnp.int32
+    hoist = TILE_D * w * 32 * elem_bits // 8 <= HOIST_PLANE_BUDGET
     kernel = functools.partial(_contains_kernel, nk=nk, wk=wk,
-                               dtype=unpack_dtype, tile_order=tile_order,
+                               plane_dt=plane_dt, tile_order=tile_order,
                                hoist=hoist, acc_dt=acc_dt)
     return pl.pallas_call(
         kernel,
@@ -255,3 +301,163 @@ def _packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(sketch_packed, ref_packed, ref_popc.reshape(1, r))
+
+
+# ---------------------------------------------------------------------------
+# Fused verdict + minimality pre-filter kernel (ISSUE 6 rung 2): the dense
+# CIND sweep without materializing the cooc count matrix in HBM.
+#
+# The materialized path (cooc.cooc_cind_tile) computes a (tile x c_pad)
+# int32 count matrix as one XLA dot — which lands in HBM between the dot
+# and the elementwise verdict/mask ops — then compares, masks, and packs.
+# Here each (128 x 128) count block only ever exists in a VMEM scratch
+# accumulator; the epilogue applies the full verdict in-register (CIND test,
+# support filter, diagonal, and the trivially-implied-pair rule of
+# data/Condition.scala:35-43 — the same masks _stage_merge applies) and
+# emits a uint8 verdict tile (4x smaller than the counts; packing to 32-bit
+# words happens in the enclosing jit — Mosaic exposes no lane-group
+# reduction to pack in-kernel) plus the per-dep referenced-set popcount the
+# minimality/extraction stages size with.
+#
+# K-step streaming (rungs 3+4): the line dimension walks a scalar-prefetched
+# block-id schedule, so all-zero (dep-tile x line-block) pairs — per-block
+# membership popcounts, the join-line skew record — are never fetched, and
+# the j/k grid dims are "arbitrary" so Mosaic double-buffers the K-step
+# operand DMAs against the previous block's matmul (the same latency-hiding
+# contract the containment kernel relies on; pltpu.emit_pipeline would hand
+# the same overlap to an inner manual pipeline, but the scalar-prefetch grid
+# is the variant every shipped jax in this stack supports — probed, not
+# assumed, like the pltpu.repeat shim).  Padded schedule entries fetch block
+# 0 and are compute-guarded by the prefetched real-block count.
+# ---------------------------------------------------------------------------
+
+CIND_BLOCK_D = 128
+CIND_BLOCK_R = 128
+
+
+def scalar_prefetch_available() -> bool:
+    """Whether this jax ships the scalar-prefetch grid the fused kernel's
+    K-step schedule rides (probe-before-assume, like the pltpu.repeat
+    shim).  Absent it, the fused path stays off and the materialized
+    sweep runs — no hard dependency on the newer API."""
+    return hasattr(pltpu, "PrefetchScalarGridSpec")
+
+
+def _fused_cind_kernel(bids_ref, nreal_ref, md_ref, mr_ref, sup_ref, ok_ref,
+                       gid_ref, dcode_ref, dv1_ref, dv2_ref, ridx_ref,
+                       rcode_ref, rv1_ref, verdict_ref, popc_ref, acc_ref, *,
+                       nk: int, acc_dt):
+    from .. import conditions as cc
+
+    j = pl.program_id(1)
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(k < nreal_ref[0])
+    def _accum():
+        acc_ref[:] += jax.lax.dot_general(
+            md_ref[:], mr_ref[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=acc_dt)
+
+    @pl.when(k == nk - 1)
+    def _finalize():
+        # f32 accumulation (bf16 membership) is exact below 2^24 lines —
+        # the same bound the dense plan enforces — so the cast is exact.
+        cooc = acc_ref[:].astype(jnp.int32)
+        sup = sup_ref[:]                      # (BLOCK_D, 1) broadcasts
+        is_cind = (cooc == sup) & (ok_ref[:] != 0)
+        is_cind &= gid_ref[:] != ridx_ref[:]  # no self-pairs
+        d_code = dcode_ref[:]
+        r_code = rcode_ref[:]
+        implied = cc.is_subcode(r_code, d_code) & jnp.where(
+            cc.first_subcapture(d_code) == r_code,
+            rv1_ref[:] == dv1_ref[:], rv1_ref[:] == dv2_ref[:])
+        v = is_cind & ~implied
+        verdict_ref[:] = v.astype(jnp.uint8)
+        row = jnp.sum(v.astype(jnp.int32), axis=1, keepdims=True)
+
+        @pl.when(j == 0)
+        def _set():
+            popc_ref[:] = row
+
+        @pl.when(j != 0)
+        def _add():
+            popc_ref[:] += row
+
+
+def fused_cind_blocks(m_dep, m, sup_col, ok_col, gid_col, dcode_col, dv1_col,
+                      dv2_col, ridx_row, rcode_row, rv1_row, block_ids,
+                      n_real, *, ref_lo: int, ref_chunk: int,
+                      interpret: bool = False):
+    """(tile x ref_chunk) fused CIND verdict + (tile, 1) per-dep popcount.
+
+    m_dep: (l_pad, tile) dep-slice of the membership matrix; m: (l_pad,
+    c_pad) the full matrix (the ref side reads blocks at a static `ref_lo`
+    column offset through the index map — no slice copy).  The *_col
+    operands are (tile, 1) per-dep columns (support, support>=min_support,
+    global capture id, code, v1, v2); the *_row operands (1, c_pad)
+    per-ref rows.  block_ids/n_real: the scalar-prefetched K schedule —
+    int32 (nk,) line-block ids (entries past n_real are padding) and the
+    (1,) real count.
+    """
+    l_pad, tile = m_dep.shape
+    c_pad = m.shape[1]
+    nk = block_ids.shape[0]
+    kl = _fused_kl(l_pad)
+    if tile % CIND_BLOCK_D or ref_chunk % CIND_BLOCK_R or l_pad % kl:
+        raise ValueError(f"fused tile not block-aligned: tile={tile} "
+                         f"ref_chunk={ref_chunk} l_pad={l_pad}")
+    acc_dt = jnp.float32 if m.dtype == jnp.bfloat16 else jnp.int32
+    grid = (tile // CIND_BLOCK_D, ref_chunk // CIND_BLOCK_R, nk)
+    rb = ref_lo // CIND_BLOCK_R
+    kernel = functools.partial(_fused_cind_kernel, nk=nk, acc_dt=acc_dt)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((kl, CIND_BLOCK_D),
+                         lambda i, j, k, b, n: (b[k], i)),
+            pl.BlockSpec((kl, CIND_BLOCK_R),
+                         lambda i, j, k, b, n: (b[k], rb + j)),
+            pl.BlockSpec((CIND_BLOCK_D, 1), lambda i, j, k, b, n: (i, 0)),
+            pl.BlockSpec((CIND_BLOCK_D, 1), lambda i, j, k, b, n: (i, 0)),
+            pl.BlockSpec((CIND_BLOCK_D, 1), lambda i, j, k, b, n: (i, 0)),
+            pl.BlockSpec((CIND_BLOCK_D, 1), lambda i, j, k, b, n: (i, 0)),
+            pl.BlockSpec((CIND_BLOCK_D, 1), lambda i, j, k, b, n: (i, 0)),
+            pl.BlockSpec((CIND_BLOCK_D, 1), lambda i, j, k, b, n: (i, 0)),
+            pl.BlockSpec((1, CIND_BLOCK_R), lambda i, j, k, b, n: (0, rb + j)),
+            pl.BlockSpec((1, CIND_BLOCK_R), lambda i, j, k, b, n: (0, rb + j)),
+            pl.BlockSpec((1, CIND_BLOCK_R), lambda i, j, k, b, n: (0, rb + j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((CIND_BLOCK_D, CIND_BLOCK_R),
+                         lambda i, j, k, b, n: (i, j)),
+            pl.BlockSpec((CIND_BLOCK_D, 1), lambda i, j, k, b, n: (i, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((CIND_BLOCK_D, CIND_BLOCK_R), acc_dt)],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((tile, ref_chunk), jnp.uint8),
+                   jax.ShapeDtypeStruct((tile, 1), jnp.int32)],
+        # i is parallel; j carries the popc accumulation and k the VMEM
+        # count accumulator, both sequential ("arbitrary") — which is also
+        # what lets Mosaic double-buffer the K-step operand DMAs.
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(block_ids, n_real, m_dep, m, sup_col, ok_col, gid_col, dcode_col,
+      dv1_col, dv2_col, ridx_row, rcode_row, rv1_row)
+
+
+def _fused_kl(l_pad: int) -> int:
+    """K-step rows per block of the fused sweep — delegated to the plan's
+    line-block granule so the kernel and the skip schedule agree."""
+    from . import cooc
+
+    return cooc.line_block_for(l_pad)

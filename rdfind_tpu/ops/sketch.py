@@ -156,12 +156,22 @@ def pallas_eligible(bits: int, backend: str | None = None) -> bool:
 
 
 def _contract_dtype() -> str:
-    """Element type of the containment contraction: the resolved cooc dtype
+    """Element type of the jnp planes contraction: the resolved cooc dtype
     (int8 by default — int32 accumulation, exact; bf16 where int8 matmul
     does not lower).  Lazy import: cooc owns the probe and the env knob."""
     from . import cooc
 
     return cooc.resolved_cooc_dtype()
+
+
+def _kernel_dtype() -> str:
+    """Unpack dtype of the packed Pallas kernel: narrows to int4 nibble
+    planes under the plane-bits policy (RDFIND_PLANE_BITS) — each MXU pass
+    then covers twice the K-dim — while the jnp fallback keeps the plain
+    cooc dtype (XLA has no portable sub-byte contraction).  Both exact."""
+    from . import cooc
+
+    return cooc.resolved_kernel_dtype()
 
 
 def contains_matrix(sketch_tile, ref_ids, ref_valid, *, bits: int,
@@ -201,7 +211,7 @@ def contains_matrix(sketch_tile, ref_ids, ref_valid, *, bits: int,
             popc = jnp.pad(popc, (0, rp), constant_values=jnp.int32(-1))
         out = pallas_kernels.packed_contains_matrix(
             sketch_tile, ref_packed, popc, interpret=interpret,
-            unpack_dtype=_contract_dtype())
+            unpack_dtype=_kernel_dtype())
         return (out[:d, :r] == 1) & ref_valid[None, :]
     return _contains_matrix_jnp(sketch_tile, ref_ids, ref_valid, bits=bits,
                                 num_hashes=num_hashes,

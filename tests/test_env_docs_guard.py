@@ -1,0 +1,48 @@
+"""Static-analysis guard: every RDFIND_* env knob must be documented.
+
+PRs 1-5 each grew env knobs, and README's "Performance tuning" section was
+back-filled by hand (PR 2) — a drift-prone arrangement: a knob shipped
+undocumented is a knob nobody can find or turn off.  Same shape as
+tests/test_obs_guard.py: a fast-tier grep over ``rdfind_tpu/`` collects
+every ``RDFIND_<NAME>`` referenced in source and fails unless README.md
+mentions it.
+"""
+
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "rdfind_tpu"
+
+_VAR = re.compile(r"\bRDFIND_[A-Z][A-Z0-9_]*\b")
+
+
+def _referenced_vars():
+    found = {}
+    for path in sorted(PKG.rglob("*.py")):
+        rel = str(path.relative_to(REPO))
+        for var in _VAR.findall(path.read_text()):
+            found.setdefault(var, rel)
+    return found
+
+
+def test_all_env_knobs_documented_in_readme():
+    readme = (REPO / "README.md").read_text()
+    documented = set(_VAR.findall(readme))
+    missing = {var: where for var, where in _referenced_vars().items()
+               if var not in documented}
+    assert not missing, (
+        "RDFIND_* env vars referenced under rdfind_tpu/ but absent from "
+        "README.md (document them in the Performance tuning / relevant "
+        "section):\n" + "\n".join(f"  {v} (first seen in {w})"
+                                  for v, w in sorted(missing.items())))
+
+
+def test_guard_sees_the_knob_surface():
+    """The grep must actually find the well-known knobs — an over-narrow
+    regex would leave the guard green while missing everything."""
+    found = _referenced_vars()
+    for var in ("RDFIND_COOC_DTYPE", "RDFIND_TILE_SCHEDULE",
+                "RDFIND_PLANE_BITS", "RDFIND_FUSE_VERDICT",
+                "RDFIND_BLOCK_SKIP"):
+        assert var in found, var

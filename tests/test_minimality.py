@@ -96,6 +96,34 @@ def test_minimize_table_empty():
     assert len(minimality.minimize_table(CindTable.empty())) == 0
 
 
+def test_implication_prefilter():
+    """The family pre-filter skips the device join exactly when no (query,
+    implying) family pair co-occurs — oracle-checked on each shape."""
+    u1, u2 = UNARY_CODES[0], UNARY_CODES[1]
+    b21 = cc.merge(u1, UNARY_CODES[2])  # a binary extending u1's family
+
+    # Pure 2/1 table: nothing can imply anything (A needs a 1/1, B a 2/2).
+    pure_21 = CindTable.from_rows({(b21, 1, 2, u2, 3, NO_VALUE, 5)})
+    assert not minimality.implication_possible(pure_21)
+    assert minimality.minimize_table(pure_21).to_rows() == \
+        oracle.minimize_cinds(pure_21.to_rows())
+
+    # Pure 1/1 table: queries exist (pass C) but no 1/2 implying rows.
+    pure_11 = CindTable.from_rows({(u1, 1, NO_VALUE, u2, 3, NO_VALUE, 5)})
+    assert not minimality.implication_possible(pure_11)
+
+    # 1/1 + 2/1 with matching subcapture values: pass A can kill, and the
+    # pre-filter must NOT short-circuit (kill verified against the oracle).
+    sub1 = int(cc.first_subcapture(b21))
+    rows = {(sub1, 1, NO_VALUE, u2, 3, NO_VALUE, 5),
+            (b21, 1, 2, u2, 3, NO_VALUE, 5)}
+    mixed = CindTable.from_rows(rows)
+    assert minimality.implication_possible(mixed)
+    got = minimality.minimize_table(mixed).to_rows()
+    assert got == oracle.minimize_cinds(rows)
+    assert len(got) < len(rows)  # the 2/1 row was killed
+
+
 def test_minimize_on_real_discovery_output():
     """allatonce raw output minimized by the device pass == oracle-minimized."""
     from rdfind_tpu.models import allatonce

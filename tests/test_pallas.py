@@ -38,13 +38,19 @@ def force_repeat_order(monkeypatch, tile_order: bool):
 
 
 @pytest.mark.parametrize("tile_order", [True, False])
-@pytest.mark.parametrize("unpack_dtype", ["int8", "bf16"])
-@pytest.mark.parametrize("seed,bits", [(0, BITS), (1, BITS), (0, 16384)])
+@pytest.mark.parametrize("unpack_dtype", ["int4", "int8", "bf16"])
+@pytest.mark.parametrize("seed,bits", [(0, BITS), (1, BITS), (0, 16384),
+                                       (2, 32768)])
 def test_packed_kernel_matches_jnp(monkeypatch, seed, bits, unpack_dtype,
                                    tile_order):
-    # bits=16384 -> W=512 words > both WK_MAX entries, exercising the K-grid
-    # accumulation (scratch init at k==0, finalize at k==nk-1) with nk >= 2
-    # plus the hoisted dep-plane chunk writes at dynamic K offsets.
+    # bits=16384 -> W=512 words > the int8/bf16 WK_MAX entries, exercising
+    # the K-grid accumulation (scratch init at k==0, finalize at k==nk-1)
+    # with nk >= 2 plus the hoisted dep-plane chunk writes at dynamic K
+    # offsets; bits=32768 (W=1024) pushes past int4's doubled WK=512 too,
+    # so the nibble mode's widened K step gets a genuine nk=2 grid.  On
+    # backends without native int4 elements the nibble mode runs its
+    # doubled-WK grid with int8 elements — the documented emulation, same
+    # arithmetic, so parity must hold everywhere.
     force_repeat_order(monkeypatch, tile_order)
     rng = np.random.default_rng(seed)
     d, r = 128, 128
@@ -60,7 +66,7 @@ def test_packed_kernel_matches_jnp(monkeypatch, seed, bits, unpack_dtype,
     np.testing.assert_array_equal(got.astype(bool), want)
 
 
-@pytest.mark.parametrize("unpack_dtype", ["int8", "bf16"])
+@pytest.mark.parametrize("unpack_dtype", ["int4", "int8", "bf16"])
 def test_packed_kernel_multi_tile_hoist(monkeypatch, unpack_dtype):
     # Multiple dep AND ref tiles: the hoisted dep-plane scratch is filled at
     # j == 0 and re-read for every later ref tile, so any staleness across
