@@ -399,6 +399,73 @@ def _bench_pipelined_passes(min_support: int) -> dict:
     return out
 
 
+def _bench_exchange(min_support: int) -> dict:
+    """Flat vs hierarchical exchange on the sharded pipeline: per-site
+    ICI/DCN byte split, wall clock, and the DCN reduction factor the
+    per-host combiner bought.  On a single-host run the 2-host pod is
+    modeled via RDFIND_HIER_HOSTS (the ledger attributes the flat run's
+    cross-host share so the comparison is apples-to-apples); a real
+    multi-process run measures the actual topology.  Outputs of the two
+    modes are asserted identical in-process — the knob only moves bytes.
+    """
+    from rdfind_tpu.models import sharded
+    from rdfind_tpu.parallel.mesh import make_mesh, topology_hosts
+    from rdfind_tpu.utils.synth import generate_triples
+
+    n = int(os.environ.get("BENCH_EXCHANGE_TRIPLES", 4_000))
+    triples = generate_triples(n, seed=47)
+    mesh = make_mesh()
+    num_dev = int(mesh.devices.size)
+    out = {"n_devices": num_dev, "n_triples": n}
+    saved = {k: os.environ.get(k)
+             for k in ("RDFIND_HIER_EXCHANGE", "RDFIND_HIER_HOSTS")}
+    try:
+        if topology_hosts(num_dev) == 1 and num_dev % 2 == 0:
+            os.environ["RDFIND_HIER_HOSTS"] = "2"  # single-host pod proxy
+        hosts = topology_hosts(num_dev)
+        out["hosts"] = hosts
+        if hosts == 1:
+            out["error"] = "device count admits no host factorization"
+            return out
+        site_cols = ("calls", "capacity", "lanes", "bytes", "ici_bytes",
+                     "dcn_bytes", "reply_bytes", "hier", "dcn_capacity",
+                     "overflow_retries")
+        rows, tables = {}, {}
+        for mode, knob in (("flat", "0"), ("hier", "1")):
+            os.environ["RDFIND_HIER_EXCHANGE"] = knob
+            stats: dict = {}
+            sharded.discover_sharded(triples, min_support, mesh=mesh,
+                                     use_fis=True, stats=stats)  # warm
+            stats = {}
+            t0 = time.perf_counter()
+            tables[mode] = sharded.discover_sharded(triples, min_support,
+                                                    mesh=mesh, use_fis=True,
+                                                    stats=stats)
+            sites = stats["exchange_sites"]
+            rows[mode] = {
+                "wall_s": round(time.perf_counter() - t0, 3),
+                "ici_bytes": sum(e["ici_bytes"] for e in sites.values()),
+                "dcn_bytes": sum(e["dcn_bytes"] for e in sites.values()),
+                "bytes": sum(e["bytes"] for e in sites.values()),
+                "sites": {s: {k: e[k] for k in site_cols}
+                          for s, e in sorted(sites.items())},
+                **obs_report.dispatch_row(stats),
+                "cinds": len(tables[mode]),
+            }
+        out.update(rows)
+        out["outputs_identical"] = (tables["flat"].to_rows()
+                                    == tables["hier"].to_rows())
+        out["dcn_reduction"] = round(
+            rows["flat"]["dcn_bytes"] / max(rows["hier"]["dcn_bytes"], 1), 3)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def _bench_ingest() -> dict:
     """Parallel vs serial native ingest on a generated multi-file workload.
 
@@ -583,6 +650,14 @@ def _run(n: int, min_support: int) -> dict:
         detail["pipelined_passes"] = _bench_pipelined_passes(min_support)
     except Exception as e:
         detail["pipelined_passes"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # Flat vs hierarchical exchange on the 2-host pod proxy (per-site
+    # ICI/DCN split + the combiner's DCN reduction; a multi-process run
+    # measures the real topology instead).
+    try:
+        detail["exchange"] = _bench_exchange(min_support)
+    except Exception as e:
+        detail["exchange"] = {"error": f"{type(e).__name__}: {e}"}
 
     # Parallel native ingest vs the serial engine (front-door throughput:
     # triples/s, bytes/s, per-phase ms, identical-output check).

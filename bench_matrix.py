@@ -12,10 +12,15 @@ measurement machinery, AbstractFlinkProgram.java:65-77,175-182): one row per
             unary+binary, support >= 100.
 
 Usage: python bench_matrix.py [--configs 1,2] [--strategies 0,1,2,3]
-                              [--dtypes int8,bf16]
+                              [--dtypes int8,bf16] [--hier off,0,1]
 Prints one JSON line per row, then a summary table on stderr.  --dtypes adds
 one row per cooc membership dtype (int8 rides the doubled int8 MXU peak and
 is exact via int32 accumulation; pass "auto" for the probe-resolved default).
+--hier adds a pod-scale exchange axis: "off" (default) keeps the
+single-device models; "0"/"1"/"auto" run the SHARDED pipeline with
+RDFIND_HIER_EXCHANGE pinned to that value (on a single-host run a 2-host
+pod is modeled via --hier-hosts so the ICI/DCN ledger split is
+meaningful), and each such row records the exchange byte totals.
 
 CIND-count note: strategies 0/2 emit every CIND; small-to-large (1) and
 late-BB (3) emit their raw forms, whose 2/1 and 2/2 families omit
@@ -25,6 +30,7 @@ so their totals are lower while the 1/1 and 1/2 families match exactly.
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -45,7 +51,8 @@ CONFIGS = {
 
 
 def run_one(config_id: int, strategy: int, dtype: str = "auto",
-            plane_bits: str = "auto", fuse: str = "auto") -> dict:
+            plane_bits: str = "auto", fuse: str = "auto",
+            hier: str = "off", hier_hosts: int = 2) -> dict:
     from rdfind_tpu.models import (allatonce, approximate, late_bb,
                                    small_to_large)
     from rdfind_tpu.ops import cooc
@@ -56,8 +63,6 @@ def run_one(config_id: int, strategy: int, dtype: str = "auto",
     if spec.get("structured"):
         from rdfind_tpu.utils.synth import inject_cind_structure
         triples = inject_cind_structure(triples)
-    discover = {0: allatonce.discover, 1: small_to_large.discover,
-                2: approximate.discover, 3: late_bb.discover}[strategy]
 
     if dtype not in ("auto", "bf16", "int8"):
         raise ValueError(f"dtype must be auto, bf16 or int8, got {dtype!r}")
@@ -66,21 +71,67 @@ def run_one(config_id: int, strategy: int, dtype: str = "auto",
                          f"got {plane_bits!r}")
     if fuse not in ("auto", "0", "1"):
         raise ValueError(f"fuse must be auto, 0 or 1, got {fuse!r}")
+    if hier not in ("off", "0", "1", "auto"):
+        raise ValueError(f"hier must be off, 0, 1 or auto, got {hier!r}")
+
+    hier_extra = {}
+    if hier == "off":
+        discover = {0: allatonce.discover, 1: small_to_large.discover,
+                    2: approximate.discover, 3: late_bb.discover}[strategy]
+        run = lambda stats: discover(triples, spec["min_support"],  # noqa: E731
+                                     stats=stats)
+    else:
+        # Pod-scale axis: the sharded pipeline with the two-level exchange
+        # pinned to this row's knob (flat vs hierarchical over the same
+        # mesh).  env is the knob's contract, saved/restored below.
+        from rdfind_tpu.models import sharded
+        from rdfind_tpu.parallel import mesh as mesh_mod
+        sharded_fn = {0: sharded.discover_sharded,
+                      1: sharded.discover_sharded_s2l,
+                      2: sharded.discover_sharded_approx,
+                      3: sharded.discover_sharded_late_bb}[strategy]
+        mesh = mesh_mod.make_mesh()
+        run = lambda stats: sharded_fn(triples, spec["min_support"],  # noqa: E731
+                                       mesh=mesh, use_fis=True, stats=stats)
+
     saved = (cooc.COOC_DTYPE, cooc.PLANE_BITS, cooc.FUSE_VERDICT)
+    saved_env = {k: os.environ.get(k)
+                 for k in ("RDFIND_HIER_EXCHANGE", "RDFIND_HIER_HOSTS")}
     cooc.COOC_DTYPE, cooc.PLANE_BITS, cooc.FUSE_VERDICT = (dtype, plane_bits,
                                                            fuse)
     try:
+        if hier != "off":
+            os.environ["RDFIND_HIER_EXCHANGE"] = hier
+            num_dev = int(mesh.devices.size)
+            if (mesh_mod.topology_hosts(num_dev) == 1
+                    and num_dev % hier_hosts == 0):
+                os.environ["RDFIND_HIER_HOSTS"] = str(hier_hosts)
         stats: dict = {}
-        discover(triples, spec["min_support"], stats=stats)  # warm (compile)
-        stats.clear()
+        run(stats)  # warm (compile)
+        stats = {}
         t0 = time.perf_counter()
-        table = discover(triples, spec["min_support"], stats=stats)
+        table = run(stats)
         wall = time.perf_counter() - t0
+        if hier != "off":
+            sites = stats.get("exchange_sites", {})
+            hier_extra = {
+                "hier": hier,
+                "hosts": mesh_mod.topology_hosts(int(mesh.devices.size)),
+                "exchange_bytes": sum(e["bytes"] for e in sites.values()),
+                "ici_bytes": sum(e["ici_bytes"] for e in sites.values()),
+                "dcn_bytes": sum(e["dcn_bytes"] for e in sites.values()),
+            }
     finally:
         cooc.COOC_DTYPE, cooc.PLANE_BITS, cooc.FUSE_VERDICT = saved
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
     total_pairs = int(stats.get("total_pairs", 0))
     return {
+        **hier_extra,
         "config": config_id,
         "label": spec["label"],
         "strategy": strategy,
@@ -112,6 +163,13 @@ def main():
                          "MXU path lowers)")
     ap.add_argument("--fuse", default="auto",
                     help="fused-verdict modes, one row each (0 | 1 | auto)")
+    ap.add_argument("--hier", default="off",
+                    help="pod-scale exchange modes, one row each (off = "
+                         "single-device models; 0 | 1 | auto = sharded "
+                         "pipeline with RDFIND_HIER_EXCHANGE pinned)")
+    ap.add_argument("--hier-hosts", type=int, default=2,
+                    help="host count modeled on single-host runs for the "
+                         "--hier rows' ICI/DCN attribution")
     args = ap.parse_args()
 
     # The axon tunnel can wedge (block inside a C call); use bench.py's
@@ -126,19 +184,24 @@ def main():
             for dtype in args.dtypes.split(","):
                 for pb in args.plane_bits.split(","):
                     for fuse in args.fuse.split(","):
-                        try:
-                            row = run_one(cid, strat, dtype=dtype.strip(),
-                                          plane_bits=pb.strip(),
-                                          fuse=fuse.strip())
-                        except Exception as e:  # keep reporting the rest
-                            row = {"config": cid, "strategy": strat,
-                                   "cooc_dtype": dtype.strip(),
-                                   "plane_bits": pb.strip(),
-                                   "fuse_verdict": fuse.strip(),
-                                   "error": f"{type(e).__name__}: {e}"}
-                        row["backend"] = backend
-                        rows.append(row)
-                        print(json.dumps(row), flush=True)
+                        for hier in args.hier.split(","):
+                            try:
+                                row = run_one(cid, strat,
+                                              dtype=dtype.strip(),
+                                              plane_bits=pb.strip(),
+                                              fuse=fuse.strip(),
+                                              hier=hier.strip(),
+                                              hier_hosts=args.hier_hosts)
+                            except Exception as e:  # keep reporting the rest
+                                row = {"config": cid, "strategy": strat,
+                                       "cooc_dtype": dtype.strip(),
+                                       "plane_bits": pb.strip(),
+                                       "fuse_verdict": fuse.strip(),
+                                       "hier": hier.strip(),
+                                       "error": f"{type(e).__name__}: {e}"}
+                            row["backend"] = backend
+                            rows.append(row)
+                            print(json.dumps(row), flush=True)
 
     print(f"{'cfg':>3} {'strat':>5} {'dtype':>5} {'wall_s':>9} "
           f"{'Mpairs/s':>9} {'cinds':>8}", file=sys.stderr)

@@ -85,8 +85,9 @@ from ..ops.emission import emit_join_candidates
 from ..obs import memory as obs_memory
 from ..obs import metrics, tracer
 from ..parallel import exchange
-from ..parallel.mesh import (AXIS, host_gather, host_gather_many, make_global,
-                             make_mesh, shard_map)
+from ..parallel.mesh import (AXIS, dcn_chunks as env_dcn_chunks, hier_spec,
+                             host_gather, host_gather_many, make_global,
+                             make_mesh, shard_map, topology_hosts)
 from ..runtime import dispatch, faults
 
 SENTINEL = segments.SENTINEL
@@ -170,19 +171,23 @@ def _freq_key_sets(triples):
 
 
 def _distributed_frequency(triples, valid_t, min_support, cap_freq,
-                           find_ar_implied):
+                           find_ar_implied, *, cap_freq_dcn=0, hier=None,
+                           dcn_chunks=1):
     """frequency.triple_frequencies with GLOBAL counts (inside shard_map).
 
     Six count exchanges (3 unary fields + 3 field pairs) against the keys' hash
     owners; all verdicts are then local per-row comparisons.  Returns
     (TripleFrequency, overflow): on overflow > 0 the verdicts are unusable and
-    the caller must retry with a larger cap_freq.
+    the caller must retry with a larger cap_freq (hierarchical mode folds the
+    DCN-budget shortfall into the same counter — the retry grows both caps).
     """
     counts = []
     ovf = jnp.int32(0)
     for key_cols, seed in _freq_key_sets(triples):
         c, o = exchange.global_row_counts(key_cols, valid_t, AXIS, cap_freq,
-                                          seed=seed)
+                                          seed=seed, hier=hier,
+                                          dcn_capacity=cap_freq_dcn,
+                                          dcn_chunks=dcn_chunks)
         counts.append(c)
         ovf = ovf + o
     unary_cnt, binary_cnt = counts[:3], counts[3:]
@@ -213,16 +218,50 @@ def _bucket_max(cols, valid, seed):
     return jax.lax.pmax(hist[:num_dev].max(), AXIS)
 
 
-def _plan_device(triples, n_valid, *, projections, use_fis, combine=True):
-    """Measured capacity needs for the frequency exchanges and exchange A."""
+def _combined_bucket_max(bucket_ix, cols, valid, seed, hier):
+    """Global max per (relay, final destination) of HOST-combined distinct
+    rows — the exact DCN-hop load of a route_combined at this site.
+
+    Members of one host all_gather their rows (intra-host collective only),
+    dedupe them host-wide, and histogram by the same bucket hash the real
+    exchange uses; hist[t] is then precisely the combined-row count the
+    relay (src_host, t % local) will slot for target t.  Exact for the same
+    reason _bucket_max is: planner and exchange share seeds.  `bucket_ix`
+    selects the hash columns (exchange A hashes join value only).
+
+    Planning-only cost: the gather transiently holds `local` x the measured
+    buffer per device.
+    """
+    num_dev = jax.lax.psum(1, AXIS)
+    intra, _ = exchange.hier_groups(hier)
+    g_cols = [jax.lax.all_gather(c, AXIS, tiled=True,
+                                 axis_index_groups=intra) for c in cols]
+    g_valid = jax.lax.all_gather(valid, AXIS, tiled=True,
+                                 axis_index_groups=intra)
+    u_cols, u_valid, _, _ = segments.masked_unique(g_cols, g_valid)
+    b = jnp.where(u_valid, hashing.bucket_of([u_cols[i] for i in bucket_ix],
+                                             num_dev, seed=seed), num_dev)
+    hist = jax.ops.segment_sum(u_valid.astype(jnp.int32), b,
+                               num_segments=num_dev + 1)
+    return jax.lax.pmax(hist[:num_dev].max(), AXIS)
+
+
+def _plan_device(triples, n_valid, *, projections, use_fis, combine=True,
+                 hier=None):
+    """Measured capacity needs for the frequency exchanges and exchange A
+    (+ their DCN-hop budgets when hierarchical; zero lanes otherwise)."""
     t = triples.shape[0]
     valid_t = jnp.arange(t, dtype=jnp.int32) < n_valid[0]
 
     cap_f = jnp.int32(0)
+    cap_fd = jnp.int32(0)
     if use_fis:
         for key_cols, seed in _freq_key_sets(triples):
             u_cols, u_valid, _, _ = segments.masked_unique(key_cols, valid_t)
             cap_f = jnp.maximum(cap_f, _bucket_max(u_cols, u_valid, seed))
+            if hier is not None:
+                cap_fd = jnp.maximum(cap_fd, _combined_bucket_max(
+                    range(len(u_cols)), u_cols, u_valid, seed, hier))
 
     # Exchange A load: unfiltered emission is an upper bound on the filtered one.
     cands = emit_join_candidates(triples, frequency.no_filter(valid_t),
@@ -231,17 +270,22 @@ def _plan_device(triples, n_valid, *, projections, use_fis, combine=True):
         cols, valid, _, _ = segments.masked_unique(
             [cands.join_val, cands.code, cands.v1, cands.v2], cands.valid)
     else:
-        cols, valid = [cands.join_val], cands.valid
+        cols = [cands.join_val, cands.code, cands.v1, cands.v2]
+        valid = cands.valid
     cap_a = _bucket_max([cols[0]], valid, _SEED_VALUE)
-    return jnp.full(1, cap_f, jnp.int32), jnp.full(1, cap_a, jnp.int32)
+    cap_ad = (_combined_bucket_max((0,), cols, valid, _SEED_VALUE, hier)
+              if hier is not None else jnp.int32(0))
+    return (jnp.full(1, cap_f, jnp.int32), jnp.full(1, cap_a, jnp.int32),
+            jnp.full(1, cap_fd, jnp.int32), jnp.full(1, cap_ad, jnp.int32))
 
 
 @functools.partial(jax.jit,
                    static_argnames=("mesh", "projections", "use_fis",
-                                    "combine"))
-def _plan_step(triples, n_valid, *, mesh, projections, use_fis, combine=True):
+                                    "combine", "hier"))
+def _plan_step(triples, n_valid, *, mesh, projections, use_fis, combine=True,
+               hier=None):
     fn = functools.partial(_plan_device, projections=projections,
-                           use_fis=use_fis, combine=combine)
+                           use_fis=use_fis, combine=combine, hier=hier)
     return shard_map(fn, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS)),
                      out_specs=P(AXIS), check_vma=False)(triples, n_valid)
 
@@ -254,14 +298,17 @@ def _plan_step(triples, n_valid, *, mesh, projections, use_fis, combine=True):
 
 def _lines_device(triples, n_valid, min_support, *, projections, use_fis,
                   use_ars, cap_freq, cap_exchange_a, skew=DEFAULT_SKEW,
-                  combine=True):
+                  combine=True, cap_freq_dcn=0, cap_exchange_a_dcn=0,
+                  hier=None, dcn_chunks=1):
     t = triples.shape[0]
     valid_t = jnp.arange(t, dtype=jnp.int32) < n_valid[0]
     num_dev = jax.lax.psum(1, AXIS)
 
     if use_fis:
         freq, ovf_f = _distributed_frequency(triples, valid_t, min_support,
-                                             cap_freq, use_ars)
+                                             cap_freq, use_ars,
+                                             cap_freq_dcn=cap_freq_dcn,
+                                             hier=hier, dcn_chunks=dcn_chunks)
     else:
         freq, ovf_f = frequency.no_filter(valid_t), jnp.int32(0)
 
@@ -277,10 +324,19 @@ def _lines_device(triples, n_valid, min_support, *, projections, use_fis,
         cols = [cands.join_val, cands.code, cands.v1, cands.v2]
         valid = cands.valid
 
-    # Exchange A: co-locate equal join values.
+    # Exchange A: co-locate equal join values.  Hierarchical mode lifts the
+    # local dedupe to a per-host dedupe at the relay (route_combined with no
+    # weight lane): only host-distinct candidate rows cross DCN, and the
+    # owner's masked_unique below sees the same distinct row set either way.
     bucket = hashing.bucket_of([cols[0]], num_dev, seed=_SEED_VALUE)
-    cols, valid, ovf_a = exchange.bucket_exchange(cols, valid, bucket, AXIS,
-                                                  cap_exchange_a)
+    if hier is None:
+        cols, valid, ovf_a = exchange.bucket_exchange(cols, valid, bucket,
+                                                      AXIS, cap_exchange_a)
+    else:
+        cols, _, valid, (ovf_a1, ovf_a2), _ = exchange.route_combined(
+            cols, None, valid, bucket, AXIS, cap_exchange_a,
+            cap_exchange_a_dcn, hier, dcn_chunks=dcn_chunks)
+        ovf_a = ovf_a1 + ovf_a2
 
     # Join lines: distinct (value, capture), sorted by value at the owner.
     cols, valid, _, n_rows = segments.masked_unique(cols, valid)
@@ -288,6 +344,9 @@ def _lines_device(triples, n_valid, min_support, *, projections, use_fis,
 
     # --- Downstream load measurement (the planning half of the skew engine).
     cap_b = _bucket_max([code, v1, v2], valid, _SEED_CAPTURE)
+    cap_bd = (_combined_bucket_max((0, 1, 2), [code, v1, v2], valid,
+                                   _SEED_CAPTURE, hier)
+              if hier is not None else jnp.int32(0))
     pos, length, _, _ = pairs.line_layout(jv, n_rows)
     is_start = valid & (pos == 0)
     len_f = length.astype(jnp.float32)
@@ -308,21 +367,25 @@ def _lines_device(triples, n_valid, min_support, *, projections, use_fis,
     g_share = jnp.minimum(giant_load / num_dev, jnp.float32(pairs.SAT))
 
     overflow = jnp.stack([ovf_f, ovf_a])
-    plan = jnp.stack([cap_b, cap_p, cap_g, g_share.astype(jnp.int32)])
+    plan = jnp.stack([cap_b, cap_bd, cap_p, cap_g, g_share.astype(jnp.int32)])
     return (jv, code, v1, v2, jnp.full(1, n_rows, jnp.int32), plan, overflow)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "projections", "use_fis", "use_ars", "cap_freq",
-                     "cap_exchange_a", "skew", "combine"))
+                     "cap_exchange_a", "skew", "combine", "cap_freq_dcn",
+                     "cap_exchange_a_dcn", "hier", "dcn_chunks"))
 def _lines_step(triples, n_valid, min_support, *, mesh, projections, use_fis,
                 use_ars, cap_freq, cap_exchange_a, skew=DEFAULT_SKEW,
-                combine=True):
+                combine=True, cap_freq_dcn=0, cap_exchange_a_dcn=0, hier=None,
+                dcn_chunks=1):
     fn = functools.partial(_lines_device, projections=projections,
                            use_fis=use_fis, use_ars=use_ars, cap_freq=cap_freq,
                            cap_exchange_a=cap_exchange_a, skew=skew,
-                           combine=combine)
+                           combine=combine, cap_freq_dcn=cap_freq_dcn,
+                           cap_exchange_a_dcn=cap_exchange_a_dcn, hier=hier,
+                           dcn_chunks=dcn_chunks)
     return shard_map(fn, mesh=mesh,
                      in_specs=(P(AXIS, None), P(AXIS), P()),
                      out_specs=P(AXIS), check_vma=False)(
@@ -387,8 +450,13 @@ def _hotlines_step(jv, n_rows, *, mesh, skew=DEFAULT_SKEW, cap_pairs=None):
 
 
 def _rebalance_device(jv, code, v1, v2, n_rows, moved_jv, moved_dest, *,
-                      cap_move):
-    """Ship rows of reassigned lines to their new owners; keep the rest."""
+                      cap_move, hier=None, dcn_chunks=1):
+    """Ship rows of reassigned lines to their new owners; keep the rest.
+
+    Destinations are data-driven (the host's greedy placement), not a hash of
+    the row — so hierarchical mode uses the slot-preserving two-hop route,
+    never the combiner (rows are globally unique; nothing would merge).
+    """
     n = jv.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
     my_idx = jax.lax.axis_index(AXIS)
@@ -399,7 +467,9 @@ def _rebalance_device(jv, code, v1, v2, n_rows, moved_jv, moved_dest, *,
     moving = match & (dest != my_idx)
     stay = valid & ~moving
     mcols, mvalid, ovf = exchange.bucket_exchange([jv, code, v1, v2], moving,
-                                                  dest, AXIS, cap_move)
+                                                  dest, AXIS, cap_move,
+                                                  hier=hier,
+                                                  dcn_chunks=dcn_chunks)
     cols_all = [jnp.concatenate([a, b])
                 for a, b in zip([jv, code, v1, v2], mcols)]
     valid_all = jnp.concatenate([stay, mvalid])
@@ -407,10 +477,12 @@ def _rebalance_device(jv, code, v1, v2, n_rows, moved_jv, moved_dest, *,
     return (*cols, jnp.full(1, n2, jnp.int32), jnp.full(1, ovf, jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "cap_move"))
+@functools.partial(jax.jit, static_argnames=("mesh", "cap_move", "hier",
+                                             "dcn_chunks"))
 def _rebalance_step(jv, code, v1, v2, n_rows, moved_jv, moved_dest, *, mesh,
-                    cap_move):
-    fn = functools.partial(_rebalance_device, cap_move=cap_move)
+                    cap_move, hier=None, dcn_chunks=1):
+    fn = functools.partial(_rebalance_device, cap_move=cap_move, hier=hier,
+                           dcn_chunks=dcn_chunks)
     return shard_map(fn, mesh=mesh,
                      in_specs=(P(AXIS),) * 5 + (P(), P()),
                      out_specs=P(AXIS), check_vma=False)(
@@ -422,23 +494,42 @@ def _rebalance_step(jv, code, v1, v2, n_rows, moved_jv, moved_dest, *, mesh,
 # ---------------------------------------------------------------------------
 
 
-def _captures_device(jv, code, v1, v2, n_rows, *, cap_exchange_b):
+def _captures_device(jv, code, v1, v2, n_rows, *, cap_exchange_b,
+                     cap_exchange_b_dcn=0, hier=None, dcn_chunks=1):
     num_dev = jax.lax.psum(1, AXIS)
     n = jv.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
     cap_bucket = hashing.bucket_of([code, v1, v2], num_dev, seed=_SEED_CAPTURE)
-    ccols, cvalid, ovf_b = exchange.bucket_exchange([code, v1, v2], valid,
-                                                    cap_bucket, AXIS,
-                                                    cap_exchange_b)
+    if hier is None:
+        ccols, cvalid, ovf_b = exchange.bucket_exchange([code, v1, v2], valid,
+                                                        cap_bucket, AXIS,
+                                                        cap_exchange_b)
+        cw = cvalid.astype(jnp.int32)
+    else:
+        # Hierarchical: pre-sum each host's duplicate captures before the DCN
+        # hop (weight = row multiplicity); the owner then sums received
+        # multiplicities instead of counting raw rows — same totals.
+        ccols, cw, cvalid, (ovf_b1, ovf_b2), _ = exchange.route_combined(
+            [code, v1, v2], jnp.ones(n, jnp.int32), valid, cap_bucket, AXIS,
+            cap_exchange_b, cap_exchange_b_dcn, hier, dcn_chunks=dcn_chunks)
+        ovf_b = ovf_b1 + ovf_b2
     tbl_cols, tbl_valid, tbl_inv, n_caps = segments.masked_unique(ccols, cvalid)
-    tbl_counts = _masked_counts(cvalid, tbl_inv, tbl_cols[0].shape[0])
+    m = tbl_cols[0].shape[0]
+    tbl_counts = jax.ops.segment_sum(jnp.where(cvalid, cw, 0),
+                                     jnp.clip(tbl_inv, 0, m - 1),
+                                     num_segments=m)
     return (tbl_cols[0], tbl_cols[1], tbl_cols[2], tbl_counts,
             jnp.full(1, n_caps, jnp.int32), jnp.full(1, ovf_b, jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "cap_exchange_b"))
-def _captures_step(jv, code, v1, v2, n_rows, *, mesh, cap_exchange_b):
-    fn = functools.partial(_captures_device, cap_exchange_b=cap_exchange_b)
+@functools.partial(jax.jit, static_argnames=("mesh", "cap_exchange_b",
+                                             "cap_exchange_b_dcn", "hier",
+                                             "dcn_chunks"))
+def _captures_step(jv, code, v1, v2, n_rows, *, mesh, cap_exchange_b,
+                   cap_exchange_b_dcn=0, hier=None, dcn_chunks=1):
+    fn = functools.partial(_captures_device, cap_exchange_b=cap_exchange_b,
+                           cap_exchange_b_dcn=cap_exchange_b_dcn, hier=hier,
+                           dcn_chunks=dcn_chunks)
     return shard_map(fn, mesh=mesh,
                      in_specs=(P(AXIS),) * 5,
                      out_specs=P(AXIS), check_vma=False)(
@@ -452,7 +543,8 @@ def _captures_step(jv, code, v1, v2, n_rows, *, mesh, cap_exchange_b):
 
 def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
                 cap_exchange_c, cap_giant, cap_giant_pairs,
-                skew=DEFAULT_SKEW, pass_idx=None, n_pass=None):
+                skew=DEFAULT_SKEW, pass_idx=None, n_pass=None,
+                cap_exchange_c_dcn=0, hier=None, dcn_chunks=1):
     """Skew-aware masked pair counting over value-sorted line rows.
 
     Emits all ordered co-occurrence pairs whose dependent row is dep-flagged and
@@ -472,8 +564,9 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
     masking (ops/pairs.emit_pair_indices `emit`) means non-emitting rows
     take zero buffer slots; n_pairs_total counts EMITTED pairs.
 
-    Returns (ucols(6), uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp),
-    n_giant_lines, n_giant_pairs, n_pairs_total).
+    Returns (ucols(6), uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd),
+    n_giant_lines, n_giant_pairs, n_pairs_total).  ovf_cd is exchange C's
+    inter-host (DCN) hop overflow; always 0 on the flat path.
     """
     num_dev = jax.lax.psum(1, AXIS)
     my_idx = jax.lax.axis_index(AXIS)
@@ -563,42 +656,54 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
     pcnt = _masked_counts(pvalid_all, pinv, pcols[0].shape[0])
 
     # Exchange C: co-locate pair partials with the dependent capture's owner.
+    # Hierarchical mode sum-combines each host's partial counts per pair key
+    # before the DCN hop (pcnt is the combine weight); the owner-side merge
+    # below is mode-agnostic — it sums whatever count lane arrives.
     pair_bucket = hashing.bucket_of(pcols[0:3], num_dev, seed=_SEED_CAPTURE)
-    mcols, mvalid, ovf_c = exchange.bucket_exchange(pcols + [pcnt], pvalid2,
-                                                    pair_bucket, AXIS,
-                                                    cap_exchange_c)
-    mkeys, mcnt_in = mcols[0:6], mcols[6]
+    if hier is None:
+        mcols, mvalid, ovf_c = exchange.bucket_exchange(pcols + [pcnt],
+                                                        pvalid2, pair_bucket,
+                                                        AXIS, cap_exchange_c)
+        mkeys, mcnt_in = mcols[0:6], mcols[6]
+        ovf_cd = jnp.int32(0)
+    else:
+        mkeys, mcnt_in, mvalid, (ovf_c, ovf_cd), _ = exchange.route_combined(
+            pcols, pcnt, pvalid2, pair_bucket, AXIS, cap_exchange_c,
+            cap_exchange_c_dcn, hier, dcn_chunks=dcn_chunks)
 
     # Merge partial counts across sources.
     ucols, uvalid, uinv, _ = segments.masked_unique(mkeys, mvalid)
     m = ucols[0].shape[0]
     cooc = jax.ops.segment_sum(jnp.where(mvalid, mcnt_in, 0),
                                jnp.clip(uinv, 0, m - 1), num_segments=m)
-    return (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp),
+    return (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd),
             n_giant_lines, n_giant_pairs, n_pairs_total)
 
 
-# Packed per-pass control lanes (exchange.pack_counters): 4 overflow counters
+# Packed per-pass control lanes (exchange.pack_counters): 5 overflow counters
 # followed by the tail counters.  ONE lane array per pass is the whole
 # device->host control surface of the pipelined executor — the host reads it
 # in a single async-staged pull instead of 3+ blocking host_gathers.
-_TELE_LANES = 7  # [ovf_p, ovf_c, ovf_g, ovf_gp, n_giant_lines, n_giant_pairs,
-#                  n_pairs_total]
-_N_OVF = 4
+_TELE_LANES = 8  # [ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd, n_giant_lines,
+#                  n_giant_pairs, n_pairs_total]
+_N_OVF = 5
 
 
 def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
                  min_support, pass_idx, n_pass, *, cap_pairs, cap_exchange_c,
-                 cap_giant, cap_giant_pairs, skew=DEFAULT_SKEW):
+                 cap_giant, cap_giant_pairs, skew=DEFAULT_SKEW,
+                 cap_exchange_c_dcn=0, hier=None, dcn_chunks=1):
     """AllAtOnce finish: all-flag pair phase + support join + CIND test."""
     n = jv.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
-    (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp), n_giant_lines,
-     n_giant_pairs, n_pairs_total) = _pair_phase(
+    (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd),
+     n_giant_lines, n_giant_pairs, n_pairs_total) = _pair_phase(
         jv, code, v1, v2, n_rows[0], valid, valid, cap_pairs=cap_pairs,
         cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
         cap_giant_pairs=cap_giant_pairs, skew=skew,
-        pass_idx=pass_idx[0], n_pass=n_pass[0])
+        pass_idx=pass_idx[0], n_pass=n_pass[0],
+        cap_exchange_c_dcn=cap_exchange_c_dcn, hier=hier,
+        dcn_chunks=dcn_chunks)
 
     # Support lookup + CIND test (same-device by shared hash _SEED_CAPTURE).
     tbl_valid = jnp.arange(tc.shape[0], dtype=jnp.int32) < n_caps[0]
@@ -612,21 +717,26 @@ def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
     keep = is_cind & ~implied
 
     out_cols, n_out = segments.compact(list(ucols) + [dep_count], keep)
-    tele = exchange.pack_counters([ovf_p, ovf_c, ovf_g, ovf_gp, n_giant_lines,
-                                   n_giant_pairs, n_pairs_total])
+    tele = exchange.pack_counters([ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd,
+                                   n_giant_lines, n_giant_pairs,
+                                   n_pairs_total])
     return (*out_cols, jnp.full(1, n_out, jnp.int32), tele)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "cap_pairs", "cap_exchange_c", "cap_giant",
-                     "cap_giant_pairs", "skew"))
+                     "cap_giant_pairs", "skew", "cap_exchange_c_dcn", "hier",
+                     "dcn_chunks"))
 def _cind_step(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
                min_support, pass_idx, n_pass, *, mesh, cap_pairs,
-               cap_exchange_c, cap_giant, cap_giant_pairs, skew=DEFAULT_SKEW):
+               cap_exchange_c, cap_giant, cap_giant_pairs, skew=DEFAULT_SKEW,
+               cap_exchange_c_dcn=0, hier=None, dcn_chunks=1):
     fn = functools.partial(_cind_device, cap_pairs=cap_pairs,
                            cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
-                           cap_giant_pairs=cap_giant_pairs, skew=skew)
+                           cap_giant_pairs=cap_giant_pairs, skew=skew,
+                           cap_exchange_c_dcn=cap_exchange_c_dcn, hier=hier,
+                           dcn_chunks=dcn_chunks)
     return shard_map(fn, mesh=mesh,
                      in_specs=(P(AXIS),) * 10 + (P(),) * 3,
                      out_specs=P(AXIS), check_vma=False)(
@@ -715,11 +825,13 @@ class _PairCapsExhausted(Exception):
 
 
 # Exchange-site lane counts for the communication ledger
-# (exchange.log_exchange): payload columns + validity lane (+ reply lane for
-# count exchanges).  Derived from the device code above — update together.
+# (exchange.log_exchange): payload columns + validity lane.  Derived from the
+# device code above — update together.
 _LANES_FREQ = 27        # 6 count exchanges: 3 unary (4 lanes) + 3 binary (5)
+_LANES_FREQ_REPLY = 6   # 6 count exchanges x 1 reply lane (route_reply /
+#                         route_combined_reply return traffic)
 _LANES_EXCHANGE_A = 5   # [jv, code, v1, v2] + validity
-_LANES_EXCHANGE_B = 4   # [code, v1, v2] + validity
+_LANES_EXCHANGE_B = 4   # [code, v1, v2] + validity (+1 weight lane when hier)
 _LANES_REBALANCE = 5    # [jv, code, v1, v2] + validity
 _LANES_EXCHANGE_C = 8   # 6 pair-key cols + count + validity
 _LANES_GIANT = 6        # [jv, code, v1, v2, flag] + validity (all_gather)
@@ -743,6 +855,12 @@ class _Pipeline:
         self.stats = stats
         self.skew = skew if skew is not None else DEFAULT_SKEW
         self.combine = combine
+        # Hierarchical (two-level ICI/DCN) exchange configuration: None = flat
+        # single-hop all_to_all; (hosts, local) factorization otherwise.
+        # hosts feeds the ledger's ICI/DCN byte attribution in BOTH modes.
+        self.hier = hier_spec(self.num_dev)
+        self.hosts = topology_hosts(self.num_dev)
+        self.dcn_chunks = env_dcn_chunks()
         # Preemption-safe per-pass checkpoints (checkpoint.ProgressStore, or
         # None): each _run_passes phase snapshots committed passes through it.
         self.progress = progress
@@ -760,26 +878,43 @@ class _Pipeline:
             self._triples = make_global(padded, mesh)
             self._n_valid = make_global(n_valid, mesh)
 
-        # P1: measured plan for the pre-exchange capacities.
-        cap_f, cap_a = _plan_step(self._triples, self._n_valid, mesh=mesh,
-                                  projections=projections, use_fis=use_fis,
-                                  combine=combine)
+        # P1: measured plan for the pre-exchange capacities.  Hierarchical
+        # mode also measures the DCN-hop (host-combined) loads exactly.
+        cap_f, cap_a, cap_fd, cap_ad = _plan_step(
+            self._triples, self._n_valid, mesh=mesh, projections=projections,
+            use_fis=use_fis, combine=combine, hier=self.hier)
         self.cap_f = _headroom(host_gather(cap_f)[0]) if use_fis else 1
         self.cap_a = _headroom(host_gather(cap_a)[0])
+        if self.hier is not None:
+            self.cap_f_dcn = (_headroom(host_gather(cap_fd)[0])
+                              if use_fis else 1)
+            self.cap_a_dcn = _headroom(host_gather(cap_ad)[0])
+        else:
+            self.cap_f_dcn = 0
+            self.cap_a_dcn = 0
 
         # P2: lines + downstream load measurement (retry on freq/A overflow).
+        hier_on = self.hier is not None
         for _ in range(max_retries):
             if use_fis:
-                exchange.log_exchange(stats, "freq", num_dev=self.num_dev,
-                                      capacity=self.cap_f, lanes=_LANES_FREQ)
-            exchange.log_exchange(stats, "exchange_a", num_dev=self.num_dev,
-                                  capacity=self.cap_a,
-                                  lanes=_LANES_EXCHANGE_A)
+                exchange.log_exchange(
+                    stats, "freq", num_dev=self.num_dev, capacity=self.cap_f,
+                    lanes=_LANES_FREQ, reply_lanes=_LANES_FREQ_REPLY,
+                    hosts=self.hosts, hier=hier_on,
+                    dcn_capacity=self.cap_f_dcn if hier_on else None)
+            exchange.log_exchange(
+                stats, "exchange_a", num_dev=self.num_dev,
+                capacity=self.cap_a, lanes=_LANES_EXCHANGE_A,
+                hosts=self.hosts, hier=hier_on,
+                dcn_capacity=self.cap_a_dcn if hier_on else None)
             out = _lines_step(
                 self._triples, self._n_valid, jnp.int32(min_support),
                 mesh=mesh, projections=projections, use_fis=use_fis,
                 use_ars=use_ars, cap_freq=self.cap_f, cap_exchange_a=self.cap_a,
-                skew=self.skew, combine=self.combine)
+                skew=self.skew, combine=self.combine,
+                cap_freq_dcn=self.cap_f_dcn,
+                cap_exchange_a_dcn=self.cap_a_dcn, hier=self.hier,
+                dcn_chunks=self.dcn_chunks)
             *line_cols, n_rows, plan, overflow = out
             ovf = host_gather(overflow).reshape(self.num_dev, 2)[0]
             if faults.overflow_injected("overflow@lines"):
@@ -789,10 +924,18 @@ class _Pipeline:
             self._count_overflow_retry(
                 "line-building",
                 site="freq" if int(ovf[0]) > 0 else "exchange_a")
+            # Hierarchical overflow counters fold both hops; growing the
+            # site's ICI and DCN capacities together keeps the retry monotone.
             if ovf[0] > 0:
                 self.cap_f = segments.pow2_capacity(2 * self.cap_f + int(ovf[0]))
+                if hier_on:
+                    self.cap_f_dcn = segments.pow2_capacity(
+                        2 * self.cap_f_dcn + int(ovf[0]))
             if ovf[1] > 0:
                 self.cap_a = segments.pow2_capacity(2 * self.cap_a + int(ovf[1]))
+                if hier_on:
+                    self.cap_a_dcn = segments.pow2_capacity(
+                        2 * self.cap_a_dcn + int(ovf[1]))
             _check_exchange_caps(self.num_dev, freq=self.cap_f,
                                  exchange_a=self.cap_a)
         else:
@@ -801,12 +944,14 @@ class _Pipeline:
                 f"freq={int(ovf[0])}, exchange_a={int(ovf[1])}")
         self.lines = line_cols  # jv, code, v1, v2 — device-resident
         self.n_rows = n_rows
-        plan = host_gather(plan).reshape(self.num_dev, 4)[0]
+        plan = host_gather(plan).reshape(self.num_dev, 5)[0]
         if os.environ.get("RDFIND_DEBUG_PLAN"):
             print(f"debug plan (per-device maxima): lines_b={int(plan[0])} "
-                  f"pairs={int(plan[1])} giant_rows={int(plan[2])} "
-                  f"giant_pairs={int(plan[3])}", file=sys.stderr, flush=True)
+                  f"lines_b_dcn={int(plan[1])} pairs={int(plan[2])} "
+                  f"giant_rows={int(plan[3])} giant_pairs={int(plan[4])}",
+                  file=sys.stderr, flush=True)
         self.cap_b = _headroom(plan[0])
+        self.cap_b_dcn = _headroom(plan[1]) if hier_on else 0
         # Bounded-memory streaming: when the measured per-device pair load
         # exceeds the row budget, the pair phase runs as n_pass dep-slice
         # passes over the resident join lines, each with ~1/n_pass the
@@ -814,17 +959,25 @@ class _Pipeline:
         # .scala:96-104, as multi-pass streaming instead of disk spill).
         budget = int(os.environ.get("RDFIND_PAIR_ROW_BUDGET",
                                     PAIR_ROW_BUDGET))
-        full_load = int(plan[1]) + 2 * int(plan[3])
+        full_load = int(plan[2]) + 2 * int(plan[4])
         self.n_pass = max(1, -(-full_load // budget))
-        self.cap_p = _headroom(int(plan[1]) // self.n_pass, floor=1 << 10)
-        self.cap_g = _headroom(plan[2])
-        self.cap_gp = _headroom(2 * int(plan[3]) // self.n_pass,
+        self.cap_p = _headroom(int(plan[2]) // self.n_pass, floor=1 << 10)
+        self.cap_g = _headroom(plan[3])
+        self.cap_gp = _headroom(2 * int(plan[4]) // self.n_pass,
                                 floor=1 << 10)
         # Exchange C per-(src, dst) capacity: the deduped pair partials are
         # hash-spread over dep-capture owners, so the expected per-destination
         # share is (pairs + giant pairs) / D; overflow retries cover skew.
         self.cap_c = _headroom((self.cap_p + self.cap_gp)
                                // max(self.num_dev, 1), floor=1 << 10)
+        # Exchange C's DCN hop carries each host's combined partials: L
+        # sources' worth of per-destination share, halved for the expected
+        # same-key overlap within a host (heuristic — pair keys are not
+        # measurable pre-pass; the overflow ladder owns the tail).
+        self.cap_c_dcn = (_headroom(
+            self.hier[1] * ((self.cap_p + self.cap_gp)
+                            // max(self.num_dev, 1)) // 2,
+            floor=1 << 10) if hier_on else 0)
         self._check_pair_caps()
         if stats is not None:
             metrics.gauge_set(stats, "n_pair_passes", self.n_pass)
@@ -834,11 +987,16 @@ class _Pipeline:
 
         # P3: capture table (retry on B overflow).
         for _ in range(max_retries):
-            exchange.log_exchange(stats, "exchange_b", num_dev=self.num_dev,
-                                  capacity=self.cap_b,
-                                  lanes=_LANES_EXCHANGE_B)
+            exchange.log_exchange(
+                stats, "exchange_b", num_dev=self.num_dev,
+                capacity=self.cap_b,
+                lanes=_LANES_EXCHANGE_B + (1 if hier_on else 0),
+                hosts=self.hosts, hier=hier_on,
+                dcn_capacity=self.cap_b_dcn if hier_on else None)
             out = _captures_step(*self.lines, self.n_rows, mesh=mesh,
-                                 cap_exchange_b=self.cap_b)
+                                 cap_exchange_b=self.cap_b,
+                                 cap_exchange_b_dcn=self.cap_b_dcn,
+                                 hier=self.hier, dcn_chunks=self.dcn_chunks)
             *tbl, n_caps, ovf_b = out
             ovf_b = int(host_gather(ovf_b)[0])
             if faults.overflow_injected("overflow@captures"):
@@ -847,17 +1005,26 @@ class _Pipeline:
                 break
             self._count_overflow_retry("capture-count", site="exchange_b")
             self.cap_b = segments.pow2_capacity(2 * self.cap_b + ovf_b)
+            if hier_on:
+                self.cap_b_dcn = segments.pow2_capacity(
+                    2 * self.cap_b_dcn + ovf_b)
             _check_caps(exchange_b=self.num_dev * self.cap_b)
         else:
             self._overflow_exhausted("capture-count", f"exchange_b={ovf_b}")
         self.tbl = tbl  # tc, tv1, tv2, tcnt — device-resident, capture-owned
         self.n_caps = n_caps
         # The PLAN-time capacities (deterministic per workload+config, unlike
-        # the grown retry caps) — part of every progress fingerprint.
+        # the grown retry caps) — part of every progress fingerprint.  DCN-hop
+        # capacities join the dict only in hierarchical mode, so flat runs
+        # keep their historical fingerprints (checkpoint compatibility).
         self._planned_caps = dict(
             freq=self.cap_f, exchange_a=self.cap_a, exchange_b=self.cap_b,
             pairs=self.cap_p, exchange_c=self.cap_c, giant_rows=self.cap_g,
             giant_pairs=self.cap_gp)
+        if hier_on:
+            self._planned_caps.update(
+                freq_dcn=self.cap_f_dcn, exchange_a_dcn=self.cap_a_dcn,
+                exchange_b_dcn=self.cap_b_dcn, exchange_c_dcn=self.cap_c_dcn)
         if stats is not None:
             metrics.struct_set(stats, "planned_caps",
                                dict(self._planned_caps))
@@ -932,10 +1099,14 @@ class _Pipeline:
             exchange.log_exchange(self.stats, "rebalance",
                                   num_dev=self.num_dev, capacity=cap_move,
                                   lanes=_LANES_REBALANCE,
-                                  rows=int(lens[moving].sum()))
+                                  rows=int(lens[moving].sum()),
+                                  hosts=self.hosts,
+                                  hier=self.hier is not None)
             out = _rebalance_step(*self.lines, self.n_rows,
                                   moved_jv, moved_dest,
-                                  mesh=self.mesh, cap_move=cap_move)
+                                  mesh=self.mesh, cap_move=cap_move,
+                                  hier=self.hier,
+                                  dcn_chunks=self.dcn_chunks)
             *cols, n_rows, ovf = out
             ovf = int(host_gather(ovf)[0])
             if faults.overflow_injected("overflow@rebalance"):
@@ -981,7 +1152,8 @@ class _Pipeline:
     def _pair_caps(self):
         return dict(cap_pairs=self.cap_p, cap_exchange_c=self.cap_c,
                     cap_giant=self.cap_g, cap_giant_pairs=self.cap_gp,
-                    skew=self.skew)
+                    skew=self.skew, cap_exchange_c_dcn=self.cap_c_dcn,
+                    hier=self.hier, dcn_chunks=self.dcn_chunks)
 
     def _grow_pair_caps(self, ovf):
         if ovf[0] > 0:
@@ -992,15 +1164,24 @@ class _Pipeline:
             self.cap_g = segments.pow2_capacity(2 * self.cap_g + int(ovf[2]))
         if ovf[3] > 0:
             self.cap_gp = segments.pow2_capacity(2 * self.cap_gp + int(ovf[3]))
+        if ovf[4] > 0:
+            self.cap_c_dcn = segments.pow2_capacity(
+                2 * self.cap_c_dcn + int(ovf[4]))
         self._check_pair_caps()
 
     def _check_pair_caps(self):
         # Local emission buffers count their own rows; exchanges B/C and the
-        # giant-line all_gather count D x capacity.
-        _check_caps(pair_stream=self.cap_p + self.cap_gp,
+        # giant-line all_gather count D x capacity.  The hierarchical DCN-hop
+        # receive buffers (hosts x dcn_capacity) are strictly smaller than
+        # their D x capacity hop-1 peers unless the dcn caps dominate.
+        caps = dict(pair_stream=self.cap_p + self.cap_gp,
                     exchange_b=self.num_dev * self.cap_b,
                     exchange_c=self.num_dev * self.cap_c,
                     giant_gather=self.num_dev * self.cap_g)
+        if self.hier is not None:
+            caps.update(exchange_b_dcn=self.hosts * self.cap_b_dcn,
+                        exchange_c_dcn=self.hosts * self.cap_c_dcn)
+        _check_caps(**caps)
 
     def collect_blocks(self, cols, n_out):
         """Per-device compacted outputs -> host rows (ONE batched pull)."""
@@ -1116,6 +1297,11 @@ class _Pipeline:
                     self.cap_c = _headroom(
                         (self.cap_p + self.cap_gp) // max(self.num_dev, 1),
                         floor=1 << 10)
+                    if self.hier is not None:
+                        self.cap_c_dcn = _headroom(
+                            self.hier[1] * ((self.cap_p + self.cap_gp)
+                                            // max(self.num_dev, 1)) // 2,
+                            floor=1 << 10)
                     self._check_pair_caps()
                     if self.stats is not None:
                         metrics.gauge_set(self.stats, "n_pair_passes",
@@ -1173,16 +1359,21 @@ class _Pipeline:
                         # optimistically dispatched passes later discarded by
                         # a rollback, so the ledger records dispatches, not
                         # committed passes.
-                        exchange.log_exchange(self.stats, "exchange_c",
-                                              num_dev=self.num_dev,
-                                              capacity=self.cap_c,
-                                              lanes=_LANES_EXCHANGE_C)
+                        hier_on = self.hier is not None
+                        exchange.log_exchange(
+                            self.stats, "exchange_c", num_dev=self.num_dev,
+                            capacity=self.cap_c, lanes=_LANES_EXCHANGE_C,
+                            hosts=self.hosts, hier=hier_on,
+                            dcn_capacity=self.cap_c_dcn if hier_on else None)
+                        # The giant-line all_gather is topology-oblivious
+                        # (whole lines replicate everywhere) — hier=False, but
+                        # host attribution still splits its ICI/DCN bytes.
                         exchange.log_exchange(
                             self.stats, "giant_gather", num_dev=self.num_dev,
                             capacity=min(
                                 self.cap_g,
                                 self.lines[0].shape[0] // self.num_dev),
-                            lanes=_LANES_GIANT)
+                            lanes=_LANES_GIANT, hosts=self.hosts)
                         cols, n_out, tele = step(self._pass_args(p_next))
                         dispatch.stage_to_host([tele])
                     inflight.append((p_next, cols, n_out, tele))
@@ -1398,7 +1589,8 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
 
 def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
                      pass_idx, n_pass, *, cap_pairs, cap_exchange_c, cap_giant,
-                     cap_giant_pairs, skew=DEFAULT_SKEW):
+                     cap_giant_pairs, skew=DEFAULT_SKEW, cap_exchange_c_dcn=0,
+                     hier=None, dcn_chunks=1):
     """One level's verification: join flags onto rows, masked pair phase."""
     n = jv.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
@@ -1413,28 +1605,35 @@ def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
     # preserves the (value, capture) sort order.
     (jv2, code2, v12, v22, df2, rf2), n_keep = segments.compact(
         [jv, code, v1, v2, dep_f, ref_f], keep)
-    (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp), n_giant_lines,
-     n_giant_pairs, n_pairs_total) = _pair_phase(
+    (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd),
+     n_giant_lines, n_giant_pairs, n_pairs_total) = _pair_phase(
         jv2, code2, v12, v22, n_keep, df2, rf2, cap_pairs=cap_pairs,
         cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
         cap_giant_pairs=cap_giant_pairs, skew=skew,
-        pass_idx=pass_idx[0], n_pass=n_pass[0])
+        pass_idx=pass_idx[0], n_pass=n_pass[0],
+        cap_exchange_c_dcn=cap_exchange_c_dcn, hier=hier,
+        dcn_chunks=dcn_chunks)
     out_cols, n_out = segments.compact(list(ucols) + [cooc], uvalid)
-    tele = exchange.pack_counters([ovf_p, ovf_c, ovf_g, ovf_gp, n_giant_lines,
-                                   n_giant_pairs, n_pairs_total])
+    tele = exchange.pack_counters([ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd,
+                                   n_giant_lines, n_giant_pairs,
+                                   n_pairs_total])
     return (*out_cols, jnp.full(1, n_out, jnp.int32), tele)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "cap_pairs", "cap_exchange_c", "cap_giant",
-                     "cap_giant_pairs", "skew"))
+                     "cap_giant_pairs", "skew", "cap_exchange_c_dcn", "hier",
+                     "dcn_chunks"))
 def _s2l_cooc(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
               pass_idx, n_pass, *, mesh, cap_pairs, cap_exchange_c, cap_giant,
-              cap_giant_pairs, skew=DEFAULT_SKEW):
+              cap_giant_pairs, skew=DEFAULT_SKEW, cap_exchange_c_dcn=0,
+              hier=None, dcn_chunks=1):
     fn = functools.partial(
         _s2l_cooc_device, cap_pairs=cap_pairs, cap_exchange_c=cap_exchange_c,
-        cap_giant=cap_giant, cap_giant_pairs=cap_giant_pairs, skew=skew)
+        cap_giant=cap_giant, cap_giant_pairs=cap_giant_pairs, skew=skew,
+        cap_exchange_c_dcn=cap_exchange_c_dcn, hier=hier,
+        dcn_chunks=dcn_chunks)
     return shard_map(
         fn, mesh=mesh,
         in_specs=(P(AXIS),) * 5 + (P(),) * 7,

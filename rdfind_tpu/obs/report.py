@@ -178,12 +178,17 @@ def format_debug_lines(stats: dict) -> list[str]:
             f"bytes/s={ing.get('bytes_per_sec')}")
     if stats.get("exchange_sites"):
         # Per-exchange communication ledger: fixed-shape collective volume
-        # per site, the input to multi-chip bandwidth projections.
+        # per site, split by interconnect tier (intra-host ICI vs inter-host
+        # DCN) — the input to multi-chip bandwidth projections.
         for site, e in sorted(stats["exchange_sites"].items()):
             lines.append(
                 f"exchange[{site}]: calls={e['calls']} "
                 f"capacity={e['capacity']} lanes={e['lanes']} "
-                f"bytes={e['bytes']} rows_capacity={e['rows_capacity']} "
+                f"bytes={e['bytes']} ici_bytes={e.get('ici_bytes', 0)} "
+                f"dcn_bytes={e.get('dcn_bytes', 0)} "
+                f"reply_bytes={e.get('reply_bytes', 0)} "
+                f"hier={e.get('hier', 0)} "
+                f"rows_capacity={e['rows_capacity']} "
                 f"overflow_retries={e['overflow_retries']}")
     if "dense_plan" in stats:
         # Dense cooc occupancy: the roofline-correcting record (issued vs

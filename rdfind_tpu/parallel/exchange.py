@@ -17,6 +17,17 @@ group-by-count-join-back that powers the sharded frequency filter (the
 reference's broadcast Bloom-filter pruning, FrequentConditionPlanner.scala:
 201-283, recast as exact counts flowing back to the asking rows).
 
+Hierarchical (pod-scale) mode: the reference survives network-bound phases by
+combining before the shuffle (Flink combiners ahead of every hash exchange);
+the flat all_to_all here makes no ICI/DCN distinction and pays full cross-host
+bandwidth for traffic that is mostly intra-host combinable.  With a
+(hosts x local_devices) factorization (`mesh.hier_spec`), `route` runs the
+shuffle as two hops — intra-host all_to_all (ICI) into a relay slot layout,
+then one inter-host exchange (DCN) — with the slot math arranged so the
+receive-side layout is bit-identical to the flat path.  `route_combined` adds
+the combiner: rows pause at the relay, duplicate (key, target-host) rows merge
+(weights sum), and only host-distinct rows cross the DCN hop.
+
 All functions assume they run inside shard_map over a 1-D mesh axis.
 """
 
@@ -45,9 +56,47 @@ def exchange_volume_bytes(num_dev: int, capacity: int, lanes: int) -> int:
     return int(num_dev) * int(num_dev) * int(capacity) * int(lanes) * 4
 
 
+def exchange_split_bytes(num_dev: int, capacity: int, lanes: int, *,
+                         hosts: int = 1, hier: bool = False,
+                         dcn_capacity: int | None = None,
+                         reply_lanes: int = 0):
+    """(ici_bytes, dcn_bytes, reply_bytes) of ONE dispatch at this site.
+
+    Attribution follows the physical link a buffer row crosses under the
+    (hosts x local) factorization: a row whose destination shares the
+    sender's host rides ICI, a cross-host row rides DCN.  Flat single-hop:
+    of each device's D destination rows, `local` stay on-host.  Hierarchical:
+    hop 1 (full D x capacity buffer) is all ICI by construction; hop 2 moves
+    `hosts` rows of `dcn_capacity` per device, hosts-1 of them cross-host.
+    Reply traffic retraces the same hops, so it splits identically;
+    `reply_bytes` is its (ICI + DCN) share of the totals.
+    """
+    d, cap = int(num_dev), int(capacity)
+    hosts = max(1, int(hosts))
+    local = max(1, d // hosts)
+    if not hier:
+        per_ici = d * local * cap * 4
+        per_dcn = d * (d - local) * cap * 4
+    else:
+        dcn_row = int(dcn_capacity) if dcn_capacity else local * cap
+        per_ici = (d * d * cap + d * dcn_row) * 4
+        per_dcn = d * (hosts - 1) * dcn_row * 4
+    all_lanes = int(lanes) + int(reply_lanes)
+    return (per_ici * all_lanes, per_dcn * all_lanes,
+            (per_ici + per_dcn) * int(reply_lanes))
+
+
+def _empty_site_entry(lanes: int = 0) -> dict:
+    return dict(calls=0, capacity=0, lanes=lanes, bytes=0, ici_bytes=0,
+                dcn_bytes=0, reply_bytes=0, reply_lanes=0, dcn_capacity=0,
+                hier=0, rows_capacity=0, rows=0, overflow_retries=0)
+
+
 def log_exchange(stats, site: str, *, num_dev: int, capacity: int,
                  lanes: int, calls: int = 1, rows: int | None = None,
-                 retries: int = 0) -> None:
+                 retries: int = 0, hosts: int = 1, hier: bool = False,
+                 dcn_capacity: int | None = None,
+                 reply_lanes: int = 0) -> None:
     """Host-side ledger of one exchange site's communication volume.
 
     The device collectives are fixed-shape, so the moved bytes are fully
@@ -58,19 +107,32 @@ def log_exchange(stats, site: str, *, num_dev: int, capacity: int,
     buffer-row upper bound the volume was provisioned for.  Multi-chip
     bandwidth projections divide `bytes` by the interconnect's measured
     throughput (VERDICT r5 #5).
+
+    `hosts`/`hier`/`dcn_capacity`/`reply_lanes` drive the ICI/DCN split
+    (exchange_split_bytes): `bytes` stays the grand total (forward + reply,
+    both links) and always equals ici_bytes + dcn_bytes.
     """
     if stats is None:
         return
-    nbytes = calls * exchange_volume_bytes(num_dev, capacity, lanes)
+    ici1, dcn1, reply1 = exchange_split_bytes(
+        num_dev, capacity, lanes, hosts=hosts, hier=hier,
+        dcn_capacity=dcn_capacity, reply_lanes=reply_lanes)
+    nbytes = calls * (ici1 + dcn1)
 
     def fn(c):
         e = c.setdefault("exchange_sites", {}).setdefault(
-            site, dict(calls=0, capacity=0, lanes=lanes, bytes=0,
-                       rows_capacity=0, rows=0, overflow_retries=0))
+            site, _empty_site_entry(lanes))
         e["calls"] += calls
         e["capacity"] = max(e["capacity"], int(capacity))
         e["lanes"] = lanes
+        e["reply_lanes"] = reply_lanes
         e["bytes"] += nbytes
+        e["ici_bytes"] += calls * ici1
+        e["dcn_bytes"] += calls * dcn1
+        e["reply_bytes"] += calls * reply1
+        e["dcn_capacity"] = max(e.get("dcn_capacity", 0),
+                                int(dcn_capacity or 0))
+        e["hier"] = max(e.get("hier", 0), 1 if hier else 0)
         e["rows_capacity"] += calls * int(num_dev) * int(capacity)
         if rows is not None:
             e["rows"] += int(rows)
@@ -78,7 +140,8 @@ def log_exchange(stats, site: str, *, num_dev: int, capacity: int,
 
     metrics.mutate(stats, fn, key="exchange_sites", kind=metrics.STRUCT)
     tracer.instant("exchange", cat=tracer.CAT_EXCHANGE, site=site,
-                   calls=calls, capacity=int(capacity), bytes=nbytes)
+                   calls=calls, capacity=int(capacity), bytes=nbytes,
+                   dcn_bytes=calls * dcn1)
 
 
 def log_exchange_retry(stats, site: str) -> None:
@@ -89,8 +152,7 @@ def log_exchange_retry(stats, site: str) -> None:
 
     def fn(c):
         e = c.setdefault("exchange_sites", {}).setdefault(
-            site, dict(calls=0, capacity=0, lanes=0, bytes=0,
-                       rows_capacity=0, rows=0, overflow_retries=0))
+            site, _empty_site_entry())
         e["overflow_retries"] += 1
 
     metrics.mutate(stats, fn, key="exchange_sites", kind=metrics.STRUCT)
@@ -118,6 +180,66 @@ def unpack_counters(host_arr, n: int, num_dev: int) -> np.ndarray:
     return np.asarray(host_arr).reshape(num_dev, n)[0]
 
 
+def hier_groups(hier):
+    """(intra, inter) axis_index_groups for a (hosts, local) factorization.
+
+    Device d = h * local + l.  `intra` groups the devices of one host (the
+    ICI hop); `inter` groups same-local-index devices across hosts (the DCN
+    hop).  Both partitions cover the axis, as all_to_all requires.
+    """
+    h, l = hier
+    intra = [[hh * l + ll for ll in range(l)] for hh in range(h)]
+    inter = [[hh * l + ll for hh in range(h)] for ll in range(l)]
+    return intra, inter
+
+
+def _a2a(buf, axis_name: str, groups=None, chunks: int = 1):
+    """Tiled row all_to_all, optionally as `chunks` independent collectives
+    over slices of the capacity axis (each slice is slot-preserving on its
+    own, so concatenation is bit-identical to the unchunked op — the chunks
+    exist to give the dispatch-ahead executor overlappable DCN pieces)."""
+    if chunks > 1 and buf.shape[1] % chunks == 0:
+        return jnp.concatenate(
+            [jax.lax.all_to_all(p, axis_name, split_axis=0, concat_axis=0,
+                                tiled=True, axis_index_groups=groups)
+             for p in jnp.split(buf, chunks, axis=1)], axis=1)
+    return jax.lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True, axis_index_groups=groups)
+
+
+def _hier_fwd(buf, hier, axis_name: str, dcn_chunks: int = 1):
+    """Two-hop forward exchange of a hier-slotted (D*capacity,) send buffer.
+
+    The sender lays rows out [l_t, h_t, k] (local index of the target first).
+    Hop 1 (ICI): each host's devices all_to_all (L, H*cap) rows, so the relay
+    device (h_s, l_t) collects every local source's block destined for local
+    index l_t — laid out [l_s, h_t, k].  A transpose regroups by target host
+    and hop 2 (DCN) all_to_alls (H, L*cap) across hosts, landing [h_s, l_s, k]
+    on the target — which IS the flat path's (src, k) receive layout, so
+    downstream consumers cannot tell the difference.
+    """
+    h, l = hier
+    intra, inter = hier_groups(hier)
+    cap = buf.shape[0] // (h * l)
+    r = jax.lax.all_to_all(buf.reshape(l, h * cap), axis_name, split_axis=0,
+                           concat_axis=0, tiled=True, axis_index_groups=intra)
+    r = r.reshape(l, h, cap).transpose(1, 0, 2).reshape(h, l * cap)
+    return _a2a(r, axis_name, groups=inter, chunks=dcn_chunks).reshape(-1)
+
+
+def _hier_back(answer, hier, axis_name: str, dcn_chunks: int = 1):
+    """Reverse both hops: a (D*capacity,) [src, k]-layout answer retraces DCN
+    then ICI back into the sender's [l_t, h_t, k] send-slot layout."""
+    h, l = hier
+    intra, inter = hier_groups(hier)
+    cap = answer.shape[0] // (h * l)
+    r = _a2a(answer.reshape(h, l * cap), axis_name, groups=inter,
+             chunks=dcn_chunks)
+    r = r.reshape(h, l, cap).transpose(1, 0, 2).reshape(l, h * cap)
+    return jax.lax.all_to_all(r, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True, axis_index_groups=intra).reshape(-1)
+
+
 @dataclasses.dataclass
 class RouteState:
     """Slot mapping of one routed exchange (everything route_reply needs)."""
@@ -127,15 +249,22 @@ class RouteState:
     ok: jnp.ndarray    # per sorted row: survived (valid and under capacity)
     num_dev: int
     capacity: int
+    hier: tuple | None = None  # (hosts, local) of the two-hop path, if taken
+    dcn_chunks: int = 1
 
 
-def route(cols, valid, bucket, axis_name: str, capacity: int):
+def route(cols, valid, bucket, axis_name: str, capacity: int, *,
+          hier=None, dcn_chunks: int = 1):
     """Route rows to the device equal to their bucket id.
 
     cols     -- list of (N,) int32 columns (row payload; SENTINEL is reserved);
     valid    -- (N,) bool;
     bucket   -- (N,) int32 destination device in [0, D);
-    capacity -- static per-destination row budget.
+    capacity -- static per-destination row budget;
+    hier     -- optional (hosts, local) factorization: run the shuffle as an
+                intra-host hop then an inter-host hop (see _hier_fwd).  The
+                receive layout, validity, and overflow are bit-identical to
+                the flat path (same per-destination slotting math).
 
     Returns (out_cols, out_valid, overflow, state): out_cols are (D*capacity,)
     columns of rows received by this device (garbage where ~out_valid); overflow
@@ -154,18 +283,29 @@ def route(cols, valid, bucket, axis_name: str, capacity: int):
     run_start = jax.lax.cummax(jnp.where(starts, idx, 0))
     pos = idx - run_start
     ok = v_s & (pos < capacity)
-    flat = jnp.where(ok, t_s * capacity + pos, d * capacity)  # OOB => dropped
+    if hier is None:
+        slot_dev = t_s
+    else:
+        # Hier send layout [l_t, h_t, k]: same (destination, pos) slots, just
+        # permuted at block granularity — ok/pos/overflow stay flat-identical.
+        hh, ll = hier
+        slot_dev = (t_s % ll) * hh + (t_s // ll)
+    flat = jnp.where(ok, slot_dev * capacity + pos, d * capacity)  # OOB => drop
     overflow_local = (v_s & ~ok).sum()
     overflow = jax.lax.psum(overflow_local, axis_name)
+
+    def xchg(buf):
+        if hier is None:
+            return jax.lax.all_to_all(buf.reshape(d, capacity), axis_name,
+                                      split_axis=0, concat_axis=0,
+                                      tiled=True).reshape(-1)
+        return _hier_fwd(buf, hier, axis_name, dcn_chunks=dcn_chunks)
 
     out_cols = []
     for c in cols:
         buf = jnp.full(d * capacity, SENTINEL, jnp.int32)
         buf = buf.at[flat].set(c[perm], mode="drop")
-        buf = buf.reshape(d, capacity)
-        recv = jax.lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
-                                  tiled=True)
-        out_cols.append(recv.reshape(-1))
+        out_cols.append(xchg(buf))
 
     # Validity travels as its own lane so payload SENTINELs stay representable.
     # NB: `ok` is already in sorted order (aligned with `flat`), unlike the
@@ -173,37 +313,193 @@ def route(cols, valid, bucket, axis_name: str, capacity: int):
     # `perm` again would sample validity from unrelated rows and silently drop
     # rows whenever the valid mask is not a compacted prefix.
     vbuf = jnp.zeros(d * capacity, jnp.int32).at[flat].set(
-        ok.astype(jnp.int32), mode="drop").reshape(d, capacity)
-    recv_v = jax.lax.all_to_all(vbuf, axis_name, split_axis=0, concat_axis=0,
-                                tiled=True)
-    state = RouteState(perm=perm, flat=flat, ok=ok, num_dev=d, capacity=capacity)
-    return out_cols, recv_v.reshape(-1) == 1, overflow, state
+        ok.astype(jnp.int32), mode="drop")
+    recv_v = xchg(vbuf)
+    state = RouteState(perm=perm, flat=flat, ok=ok, num_dev=d,
+                       capacity=capacity, hier=hier, dcn_chunks=dcn_chunks)
+    return out_cols, recv_v == 1, overflow, state
 
 
 def route_reply(answer, state: RouteState, axis_name: str):
     """Send one (D*capacity,) int32 answer-per-received-row back to the senders.
 
     Returns an (N,) column in the *original row order* of the route() call; rows
-    that were dropped (overflow) or invalid get 0.
+    that were dropped (overflow) or invalid get 0.  A hierarchical route's
+    reply retraces both hops in reverse (DCN then ICI) into the same send
+    slots, so the caller-visible contract is unchanged.
     """
     n = state.perm.shape[0]
-    buf = answer.reshape(state.num_dev, state.capacity)
-    back = jax.lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
-                              tiled=True).reshape(-1)
+    if state.hier is None:
+        back = jax.lax.all_to_all(
+            answer.reshape(state.num_dev, state.capacity), axis_name,
+            split_axis=0, concat_axis=0, tiled=True).reshape(-1)
+    else:
+        back = _hier_back(answer, state.hier, axis_name,
+                          dcn_chunks=state.dcn_chunks)
     safe = jnp.clip(state.flat, 0, state.num_dev * state.capacity - 1)
     vals = jnp.where(state.ok, back[safe], 0)
     return jnp.zeros(n, jnp.int32).at[state.perm].set(vals)
 
 
-def bucket_exchange(cols, valid, bucket, axis_name: str, capacity: int):
+def bucket_exchange(cols, valid, bucket, axis_name: str, capacity: int, *,
+                    hier=None, dcn_chunks: int = 1):
     """route() without the reply half (the one-way shuffle)."""
     out_cols, out_valid, overflow, _ = route(cols, valid, bucket, axis_name,
-                                             capacity)
+                                             capacity, hier=hier,
+                                             dcn_chunks=dcn_chunks)
     return out_cols, out_valid, overflow
 
 
+@dataclasses.dataclass
+class CombinedState:
+    """Slot + combine mappings of one route_combined (for the reply path)."""
+
+    perm: jnp.ndarray   # hop 1: sorted order -> original row index
+    flat: jnp.ndarray   # hop 1: per sorted row, hier send-buffer slot
+    ok: jnp.ndarray     # hop 1: per sorted row, survived
+    uinv: jnp.ndarray   # relay: row -> its combined unique row
+    rvalid: jnp.ndarray  # relay: received-row validity
+    perm2: jnp.ndarray  # hop 2: sorted order -> unique row index
+    flat2: jnp.ndarray  # hop 2: per sorted unique row, DCN send-buffer slot
+    ok2: jnp.ndarray    # hop 2: per sorted unique row, survived
+    num_dev: int
+    capacity: int
+    dcn_capacity: int
+    hier: tuple
+    dcn_chunks: int = 1
+
+
+def route_combined(cols, weight, valid, bucket, axis_name: str,
+                   capacity: int, dcn_capacity: int, hier, *,
+                   dcn_chunks: int = 1):
+    """Two-level route with per-host pre-aggregation before the DCN hop (the
+    Flink combiner-before-shuffle analog).
+
+    Rows ride the ICI hop exactly as route(hier=...) — same slotting math,
+    so `overflow` is bit-identical to the flat path's count — but pause at
+    the intra-host relay, where duplicate (key columns, target host) rows
+    merge into one: `weight` sum-combines (pass ones for multiplicities;
+    None skips the weight lane entirely — pure dedupe, out_weight is None).
+    Only the host-distinct survivors cross the DCN hop, into a separate
+    (hosts, dcn_capacity) budget.
+
+    REQUIRES `bucket` to be a pure function of `cols`: rows that compare
+    equal on the key columns must share a destination, or merging them would
+    change routing semantics.  Every combined call site hashes the key
+    columns (rebalance's data-driven destinations use the slot-preserving
+    route instead).
+
+    Returns (out_cols, out_weight, out_valid, (overflow, overflow_dcn),
+    state): out_* are (hosts*dcn_capacity,) combined rows received by the
+    owner — the same key may still arrive once per source HOST, so owners
+    keep their masked_unique/segment-sum merge; summed integer weights make
+    downstream totals bit-identical to the flat path.  `state` feeds
+    route_combined_reply.
+    """
+    hh, ll = hier
+    d = jax.lax.psum(1, axis_name)
+    n = cols[0].shape[0]
+    intra, inter = hier_groups(hier)
+
+    # Hop 1 (ICI): route()'s slotting math verbatim, hier send layout.
+    tgt = jnp.where(valid, bucket, d)
+    perm = segments.lexsort([tgt])
+    t_s = tgt[perm]
+    v_s = valid[perm]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    starts = segments.run_starts([t_s])
+    run_start = jax.lax.cummax(jnp.where(starts, idx, 0))
+    pos = idx - run_start
+    ok = v_s & (pos < capacity)
+    slot_dev = (t_s % ll) * hh + (t_s // ll)
+    flat = jnp.where(ok, slot_dev * capacity + pos, d * capacity)
+    overflow = jax.lax.psum((v_s & ~ok).sum(), axis_name)
+
+    def hop1(c, fill):
+        buf = jnp.full(d * capacity, fill, jnp.int32).at[flat].set(
+            c, mode="drop").reshape(ll, hh * capacity)
+        return jax.lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                                  tiled=True,
+                                  axis_index_groups=intra).reshape(-1)
+
+    r_cols = [hop1(c[perm], SENTINEL) for c in cols]
+    r_w = hop1(jnp.where(ok, weight[perm], 0), 0) if weight is not None \
+        else None
+    rvalid = hop1(ok.astype(jnp.int32), 0) == 1
+
+    # Relay combine: rows sit at [l_s, h_t, k], so the target host of slot i
+    # is structural — no re-hash needed.  Merge per (key, target host).
+    m = d * capacity
+    r_ht = (jnp.arange(m, dtype=jnp.int32) // capacity) % hh
+    u_cols, u_valid, uinv, _ = segments.masked_unique(r_cols + [r_ht], rvalid)
+    uinv_safe = jnp.clip(uinv, 0, m - 1)
+    u_w = (jax.ops.segment_sum(jnp.where(rvalid, r_w, 0), uinv_safe,
+                               num_segments=m)
+           if r_w is not None else None)
+    u_ht = u_cols[-1]
+
+    # Hop 2 (DCN): slot the combined rows against the per-host budget.
+    tgt2 = jnp.where(u_valid, u_ht, hh)
+    perm2 = segments.lexsort([tgt2])
+    t2_s = tgt2[perm2]
+    v2_s = u_valid[perm2]
+    idx2 = jnp.arange(m, dtype=jnp.int32)
+    starts2 = segments.run_starts([t2_s])
+    rs2 = jax.lax.cummax(jnp.where(starts2, idx2, 0))
+    pos2 = idx2 - rs2
+    ok2 = v2_s & (pos2 < dcn_capacity)
+    flat2 = jnp.where(ok2, t2_s * dcn_capacity + pos2, hh * dcn_capacity)
+    overflow_dcn = jax.lax.psum((v2_s & ~ok2).sum(), axis_name)
+
+    def hop2(c, fill):
+        buf = jnp.full(hh * dcn_capacity, fill, jnp.int32).at[flat2].set(
+            c, mode="drop").reshape(hh, dcn_capacity)
+        return _a2a(buf, axis_name, groups=inter,
+                    chunks=dcn_chunks).reshape(-1)
+
+    out_cols = [hop2(c[perm2], SENTINEL) for c in u_cols[:-1]]
+    out_w = hop2(u_w[perm2], 0) if u_w is not None else None
+    out_valid = hop2(ok2.astype(jnp.int32), 0) == 1
+    state = CombinedState(perm=perm, flat=flat, ok=ok, uinv=uinv,
+                          rvalid=rvalid, perm2=perm2, flat2=flat2, ok2=ok2,
+                          num_dev=d, capacity=capacity,
+                          dcn_capacity=dcn_capacity, hier=hier,
+                          dcn_chunks=dcn_chunks)
+    return out_cols, out_w, out_valid, (overflow, overflow_dcn), state
+
+
+def route_combined_reply(answer, state: CombinedState, axis_name: str):
+    """Per-received-combined-row answers back to the ORIGINAL senders' rows.
+
+    Reverses the DCN hop to the relay, fans each combined row's answer out to
+    every relay row that merged into it, then reverses the ICI hop.  Returns
+    an (N,) column in route_combined()'s original row order (0 where the row
+    was invalid or dropped at either hop).
+    """
+    hh, ll = state.hier
+    cap, dcap = state.capacity, state.dcn_capacity
+    intra, inter = hier_groups(state.hier)
+    m = state.perm2.shape[0]
+    back2 = _a2a(answer.reshape(hh, dcap), axis_name, groups=inter,
+                 chunks=state.dcn_chunks).reshape(-1)
+    safe2 = jnp.clip(state.flat2, 0, hh * dcap - 1)
+    vals2 = jnp.where(state.ok2, back2[safe2], 0)
+    ans_comb = jnp.zeros(m, jnp.int32).at[state.perm2].set(vals2)
+    # Fan out: every relay row inherits its combined representative's answer.
+    uinv_safe = jnp.clip(state.uinv, 0, m - 1)
+    ans_relay = jnp.where(state.rvalid, ans_comb[uinv_safe], 0)
+    back1 = jax.lax.all_to_all(
+        ans_relay.reshape(ll, hh * cap), axis_name, split_axis=0,
+        concat_axis=0, tiled=True, axis_index_groups=intra).reshape(-1)
+    n = state.perm.shape[0]
+    safe1 = jnp.clip(state.flat, 0, state.num_dev * cap - 1)
+    vals = jnp.where(state.ok, back1[safe1], 0)
+    return jnp.zeros(n, jnp.int32).at[state.perm].set(vals)
+
+
 def global_row_counts(key_cols, valid, axis_name: str, capacity: int, *,
-                      seed: int):
+                      seed: int, hier=None, dcn_capacity: int | None = None,
+                      dcn_chunks: int = 1):
     """Per-row GLOBAL count of the row's key across all devices.
 
     Combiner-tree + join-back in one primitive: local distinct keys carry their
@@ -211,8 +507,15 @@ def global_row_counts(key_cols, valid, axis_name: str, capacity: int, *,
     keys, not rows), the owner sums them, and the sums ride the reply collective
     back to every asking row.  Exchange volume is O(local distinct keys).
 
+    Hierarchical mode (`hier` + `dcn_capacity`) lifts the combiner a level:
+    per-DEVICE distinct keys merge into per-HOST distinct keys at the relay
+    (local multiplicities sum there), and only those cross DCN.  Integer sums
+    are order-free, so the returned counts are bit-identical; hop-1 overflow
+    matches flat bit-for-bit and DCN-budget overflow folds into the same
+    returned counter (either way the caller's contract is "retry bigger").
+
     Returns (counts, overflow): counts is (N,) int32, 0 for invalid rows;
-    overflow > 0 means `capacity` was too small and counts are unusable.
+    overflow > 0 means a capacity was too small and counts are unusable.
     """
     d = jax.lax.psum(1, axis_name)
     u_cols, u_valid, inv, _ = segments.masked_unique(key_cols, valid)
@@ -221,15 +524,25 @@ def global_row_counts(key_cols, valid, axis_name: str, capacity: int, *,
     local_mult = jax.ops.segment_sum(valid.astype(jnp.int32), inv_safe,
                                      num_segments=m)
     bucket = hashing.bucket_of(u_cols, d, seed=seed)
-    recv, recv_valid, overflow, state = route(u_cols + [local_mult], u_valid,
-                                              bucket, axis_name, capacity)
-    g = segments.masked_weighted_row_counts(recv[:-1], recv[-1], recv_valid)
-    ans_per_distinct = route_reply(g, state, axis_name)
-    return jnp.where(valid, ans_per_distinct[inv_safe], 0), overflow
+    if hier is None:
+        recv, recv_valid, overflow, state = route(
+            u_cols + [local_mult], u_valid, bucket, axis_name, capacity)
+        g = segments.masked_weighted_row_counts(recv[:-1], recv[-1],
+                                                recv_valid)
+        ans_per_distinct = route_reply(g, state, axis_name)
+        return jnp.where(valid, ans_per_distinct[inv_safe], 0), overflow
+    recv, recv_w, recv_valid, (ovf, ovf_dcn), state = route_combined(
+        u_cols, local_mult, u_valid, bucket, axis_name, capacity,
+        dcn_capacity, hier, dcn_chunks=dcn_chunks)
+    g = segments.masked_weighted_row_counts(recv, recv_w, recv_valid)
+    ans_per_distinct = route_combined_reply(g, state, axis_name)
+    return jnp.where(valid, ans_per_distinct[inv_safe], 0), ovf + ovf_dcn
 
 
 def global_distinct_frequent(key_cols, valid, min_support, axis_name: str,
-                             capacity: int, *, seed: int):
+                             capacity: int, *, seed: int, hier=None,
+                             dcn_capacity: int | None = None,
+                             dcn_chunks: int = 1):
     """GLOBAL number of distinct keys occurring >= min_support times.
 
     The distributed form of the --find-only-fcs report (the reference counts
@@ -245,12 +558,22 @@ def global_distinct_frequent(key_cols, valid, min_support, axis_name: str,
     local_mult = jax.ops.segment_sum(valid.astype(jnp.int32), inv_safe,
                                      num_segments=m)
     bucket = hashing.bucket_of(u_cols, d, seed=seed)
-    recv, recv_valid, overflow, _ = route(u_cols + [local_mult], u_valid,
-                                          bucket, axis_name, capacity)
-    g = segments.masked_weighted_row_counts(recv[:-1], recv[-1], recv_valid)
+    if hier is None:
+        recv, recv_valid, overflow, _ = route(u_cols + [local_mult], u_valid,
+                                              bucket, axis_name, capacity)
+        g = segments.masked_weighted_row_counts(recv[:-1], recv[-1],
+                                                recv_valid)
+        ok = recv_valid & (g >= min_support)
+        _, _, _, n_u = segments.masked_unique(recv[:-1], ok)
+        return jax.lax.psum(n_u, axis_name), overflow
+    recv, recv_w, recv_valid, (ovf, ovf_dcn), _ = route_combined(
+        u_cols, local_mult, u_valid, bucket, axis_name, capacity,
+        dcn_capacity, hier, dcn_chunks=dcn_chunks)
+    # The owner still dedupes: the same key arrives once per source HOST.
+    g = segments.masked_weighted_row_counts(recv, recv_w, recv_valid)
     ok = recv_valid & (g >= min_support)
-    _, _, _, n_u = segments.masked_unique(recv[:-1], ok)
-    return jax.lax.psum(n_u, axis_name), overflow
+    _, _, _, n_u = segments.masked_unique(recv, ok)
+    return jax.lax.psum(n_u, axis_name), ovf + ovf_dcn
 
 
 def sorted_join_counts(table_cols, table_counts, table_valid, query_cols, query_valid):

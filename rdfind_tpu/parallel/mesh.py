@@ -16,12 +16,65 @@ orchestration reads global state via `host_gather`.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 AXIS = "d"
+
+
+def topology_hosts(num_dev: int) -> int:
+    """How many hosts the `num_dev`-device mesh spans, for ledger attribution
+    and the hierarchical factorization.
+
+    `RDFIND_HIER_HOSTS` overrides the runtime's process count — that is how
+    single-process tests (8 fake CPU devices) and benches model a 2-host pod
+    proxy.  A host count that does not divide the mesh degenerates to 1
+    (every device "local"): the hierarchical path requires an even
+    (hosts x local) factorization of the axis.
+    """
+    try:
+        hosts = int(os.environ.get("RDFIND_HIER_HOSTS", "") or
+                    jax.process_count())
+    except ValueError:
+        hosts = jax.process_count()
+    if hosts < 1 or num_dev % hosts != 0:
+        return 1
+    return hosts
+
+
+def hier_spec(num_dev: int):
+    """Resolve `RDFIND_HIER_EXCHANGE` to a (hosts, local_devices) factorization
+    of the 1-D axis, or None for the flat single-hop exchange.
+
+    auto (default) -- hierarchical only when the mesh spans >1 host (flat is
+    strictly cheaper on one host: the two-level path moves every row twice);
+    1 -- force hierarchical even single-host (tests / benches exercise the
+    path via `RDFIND_HIER_HOSTS`); 0 -- force the flat path exactly.
+    """
+    knob = os.environ.get("RDFIND_HIER_EXCHANGE", "auto").strip().lower()
+    if knob in ("0", "off", "flat"):
+        return None
+    hosts = topology_hosts(num_dev)
+    if knob in ("1", "on", "force"):
+        return (hosts, num_dev // hosts)
+    if hosts <= 1:  # auto
+        return None
+    return (hosts, num_dev // hosts)
+
+
+def dcn_chunks() -> int:
+    """`RDFIND_HIER_DCN_CHUNKS`: split the inter-host hop of a hierarchical
+    exchange into this many independent all_to_all slices of the capacity
+    axis (overlap food for the dispatch-ahead executor).  1 = one collective.
+    """
+    try:
+        return max(1, int(os.environ.get("RDFIND_HIER_DCN_CHUNKS", "1")))
+    except ValueError:
+        return 1
 
 
 def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):

@@ -29,6 +29,26 @@ def main():
     assert jax.device_count() == 4 * nproc
     mesh = mesh_mod.make_mesh()
     triples = generate_triples(200, seed=3, n_predicates=6, n_entities=24)
+    if strategy == "hier":
+        # Differential: flat vs hierarchical exchange over REAL process
+        # boundaries (jax.process_count()==2, so RDFIND_HIER_EXCHANGE=auto
+        # resolves to the (2, 4) factorization on its own).  Same rows, and
+        # the combiner must move strictly fewer inter-host bytes.
+        results = {}
+        for knob in ("0", "auto"):
+            os.environ["RDFIND_HIER_EXCHANGE"] = knob
+            stats: dict = {}
+            table = sharded.discover_sharded(triples, 2, mesh=mesh,
+                                             use_fis=True, stats=stats)
+            results[knob] = (sorted(table.to_rows()),
+                             {s: e["dcn_bytes"]
+                              for s, e in stats["exchange_sites"].items()})
+        if pid == 0:
+            print("ROWS " + json.dumps(results["0"][0]), flush=True)
+            print("ROWS_HIER " + json.dumps(results["auto"][0]), flush=True)
+            print("DCN " + json.dumps([results["0"][1], results["auto"][1]]),
+                  flush=True)
+        return
     fn = {"0": sharded.discover_sharded,
           "1": sharded.discover_sharded_s2l}[strategy]
     table = fn(triples, 2, mesh=mesh)

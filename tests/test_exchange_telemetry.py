@@ -73,6 +73,15 @@ def test_sharded_run_records_all_pipeline_sites(mesh8):
         assert e["calls"] >= 1
         assert e["lanes"] == lanes
         assert e["bytes"] > 0 and e["capacity"] > 0
+        # ICI/DCN attribution always partitions the total (single host:
+        # everything is ICI, reply traffic included in the lanes' total).
+        assert e["bytes"] == e["ici_bytes"] + e["dcn_bytes"]
+        assert e["dcn_bytes"] == 0  # single-host run
+    # The six frequency count exchanges ship reply lanes; the one-way
+    # shuffles do not.
+    assert sites["freq"]["reply_lanes"] == sharded._LANES_FREQ_REPLY
+    assert sites["freq"]["reply_bytes"] > 0
+    assert sites["exchange_a"]["reply_lanes"] == 0
     # exchange_c dispatches once per pass (at least n_pair_passes calls).
     assert sites["exchange_c"]["calls"] >= stats["n_pair_passes"]
     # A clean run retried nothing.

@@ -1,0 +1,252 @@
+"""Hierarchical (two-level ICI/DCN) exchange: parity with the flat path.
+
+The pod-scale exchange restructures every bucket-owner shuffle as an
+intra-host all_to_all followed by an inter-host hop (parallel/exchange.py
+_hier_fwd/_hier_back, route_combined).  Its whole contract is *bit-identical
+receive buffers*: same rows in the same slots, same validity, same overflow
+counts, same replies — for every (hosts x local) factorization of the axis,
+including the degenerate 1xN and Nx1 ones.  These tests fuzz that contract
+and pin the ledger's ICI/DCN byte-split math.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from rdfind_tpu.ops import hashing
+from rdfind_tpu.parallel import exchange
+from rdfind_tpu.parallel.mesh import AXIS, make_mesh, shard_map
+
+D = 8
+N = 64  # rows per device
+FACTORIZATIONS = [(1, 8), (2, 4), (4, 2), (8, 1)]
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 CPU devices"
+    return make_mesh(8)
+
+
+def _run(mesh, fn, *arrs):
+    sm = shard_map(fn, mesh=mesh, in_specs=(P(AXIS),) * len(arrs),
+                   out_specs=P(AXIS), check_vma=False)
+    return np.asarray(jax.jit(sm)(*arrs))
+
+
+def _fuzz(seed, n_keys=12, p_valid=0.8):
+    rng = np.random.default_rng(seed)
+    cols = np.asarray(rng.integers(0, n_keys, size=(2, D * N)), np.int32)
+    valid = np.asarray(rng.random(D * N) < p_valid)
+    wt = np.asarray(rng.integers(1, 5, size=D * N), np.int32)
+    return cols, valid, wt
+
+
+def _route_prog(capacity, hier, dcn_chunks=1):
+    """Forward route + reply, stacked into one comparable output block."""
+
+    def f(c0, c1, w, v):
+        bucket = hashing.bucket_of([c0, c1], D, seed=3)
+        out, ov, ovf, st = exchange.route([c0, c1, w], v, bucket, AXIS,
+                                          capacity, hier=hier,
+                                          dcn_chunks=dcn_chunks)
+        # Reply: a value derived from each received row, echoed to senders.
+        ans = exchange.route_reply(jnp.where(ov, out[2] * 2 + 1, 0), st, AXIS)
+        ansp = jnp.pad(ans, (0, max(D * capacity - N, 0)))[:D * capacity]
+        return jnp.stack(out + [ov.astype(jnp.int32), ansp,
+                                jnp.broadcast_to(ovf, ov.shape)])
+
+    return f
+
+
+@pytest.mark.parametrize("hier", FACTORIZATIONS)
+def test_route_roundtrip_parity(mesh8, hier):
+    """Receive order, validity, overflow, and replies are bit-identical
+    flat vs hierarchical for every factorization."""
+    cols, valid, wt = _fuzz(seed=0)
+    flat = _run(mesh8, _route_prog(16, None), cols[0], cols[1], wt, valid)
+    got = _run(mesh8, _route_prog(16, hier), cols[0], cols[1], wt, valid)
+    np.testing.assert_array_equal(flat, got)
+
+
+def test_route_parity_under_overflow(mesh8):
+    """A capacity small enough to drop rows drops the SAME rows either way
+    (hier reuses the flat slotting math before permuting the send layout)."""
+    cols, valid, wt = _fuzz(seed=1, n_keys=4)  # few keys => hot buckets
+    flat = _run(mesh8, _route_prog(4, None), cols[0], cols[1], wt, valid)
+    assert flat[-1].max() > 0, "fixture should overflow"
+    for hier in FACTORIZATIONS:
+        got = _run(mesh8, _route_prog(4, hier), cols[0], cols[1], wt, valid)
+        np.testing.assert_array_equal(flat, got)
+
+
+def test_route_dcn_chunking_parity(mesh8):
+    """Chunked DCN hops concatenate bit-identically (each chunk is
+    slot-preserving on its own slice of the capacity axis)."""
+    cols, valid, wt = _fuzz(seed=2)
+    flat = _run(mesh8, _route_prog(16, None), cols[0], cols[1], wt, valid)
+    for chunks in (2, 4):
+        got = _run(mesh8, _route_prog(16, (2, 4), chunks),
+                   cols[0], cols[1], wt, valid)
+        np.testing.assert_array_equal(flat, got)
+
+
+def test_bucket_exchange_parity(mesh8):
+    cols, valid, _ = _fuzz(seed=3)
+
+    def prog(hier):
+        def f(c0, c1, v):
+            bucket = hashing.bucket_of([c0], D, seed=5)
+            out, ov, ovf = exchange.bucket_exchange([c0, c1], v, bucket,
+                                                    AXIS, 16, hier=hier)
+            return jnp.stack(out + [ov.astype(jnp.int32),
+                                    jnp.broadcast_to(ovf, ov.shape)])
+        return f
+
+    flat = _run(mesh8, prog(None), cols[0], cols[1], valid)
+    for hier in FACTORIZATIONS:
+        got = _run(mesh8, prog(hier), cols[0], cols[1], valid)
+        np.testing.assert_array_equal(flat, got)
+
+
+def test_route_combined_weight_sums(mesh8):
+    """Owner replies carry the per-(key, source host) combined weight sums —
+    the combiner merged exactly the duplicate rows of one host."""
+    cols, valid, wt = _fuzz(seed=4)
+    hier = (2, 4)
+
+    def f(c0, c1, w, v):
+        bucket = hashing.bucket_of([c0, c1], D, seed=3)
+        out, ow, ov, (o1, o2), st = exchange.route_combined(
+            [c0, c1], w, v, bucket, AXIS, 16, 64, hier)
+        ans = exchange.route_combined_reply(jnp.where(ov, ow, 0), st, AXIS)
+        return jnp.stack([ans, jnp.broadcast_to(o1, ans.shape),
+                          jnp.broadcast_to(o2, ans.shape)])
+
+    got = _run(mesh8, f, cols[0], cols[1], wt, valid).reshape(D, 3, N)
+    assert got[:, 1:].max() == 0  # ample capacities: no overflow at either hop
+    # Host of device d under (2, 4): d // 4.  Expected answer for a valid row
+    # = sum of weights over same-key valid rows on the same host.
+    host_of = (np.arange(D * N) // N) // 4
+    keys = cols[0].astype(np.int64) * (1 << 20) + cols[1]
+    ans = got[:, 0].reshape(-1)
+    wt_l = wt.astype(np.int64)
+    for r in range(D * N):
+        exp = (wt_l[valid & (host_of == host_of[r])
+                    & (keys == keys[r])].sum() if valid[r] else 0)
+        assert ans[r] == exp, r
+
+
+def test_route_combined_dedupe_matches_flat_distinct(mesh8):
+    """weight=None: the owner's distinct key set equals the flat route's
+    (pure per-host dedupe loses no keys and invents none)."""
+    cols, valid, _ = _fuzz(seed=5)
+
+    def distinct_after(hier):
+        def f(c0, c1, v):
+            bucket = hashing.bucket_of([c0, c1], D, seed=3)
+            if hier is None:
+                out, ov, _ = exchange.bucket_exchange([c0, c1], v, bucket,
+                                                      AXIS, 16)
+            else:
+                out, ow, ov, _, _ = exchange.route_combined(
+                    [c0, c1], None, v, bucket, AXIS, 16, 64, hier)
+                assert ow is None  # no weight lane requested, none returned
+            from rdfind_tpu.ops import segments
+            u, uv, _, nu = segments.masked_unique(out, ov)
+            k = jnp.where(uv, u[0] * (1 << 20) + u[1], -1)
+            return jnp.pad(jnp.sort(k)[::-1], (0, 2 * D * 16))[:D * 16]
+        return f
+
+    flat = _run(mesh8, distinct_after(None), cols[0], cols[1], valid)
+    for hier in [(2, 4), (4, 2)]:
+        got = _run(mesh8, distinct_after(hier), cols[0], cols[1], valid)
+        np.testing.assert_array_equal(flat, got)
+
+
+def test_route_combined_dcn_overflow_counted(mesh8):
+    """A starved DCN budget reports through the second overflow counter."""
+    cols, valid, wt = _fuzz(seed=6)
+
+    def f(c0, c1, w, v):
+        bucket = hashing.bucket_of([c0, c1], D, seed=3)
+        _, _, _, (o1, o2), _ = exchange.route_combined(
+            [c0, c1], w, v, bucket, AXIS, 16, 1, (2, 4))
+        return jnp.stack([jnp.broadcast_to(o1, (N,)),
+                          jnp.broadcast_to(o2, (N,))])
+
+    got = _run(mesh8, f, cols[0], cols[1], wt, valid).reshape(D, 2, N)
+    assert got[:, 0].max() == 0  # ICI hop had room
+    assert got[:, 1].max() > 0   # DCN budget of 1 row/host must starve
+
+
+@pytest.mark.parametrize("hier", [(2, 4), (4, 2), (8, 1), (1, 8)])
+def test_global_counts_parity(mesh8, hier):
+    cols, valid, _ = _fuzz(seed=7)
+
+    def grc(h):
+        def f(c0, c1, v):
+            cnt, ovf = exchange.global_row_counts(
+                [c0, c1], v, AXIS, 16, seed=5, hier=h,
+                dcn_capacity=64 if h else None)
+            return jnp.stack([cnt, jnp.broadcast_to(ovf, cnt.shape)])
+        return f
+
+    def gdf(h):
+        def f(c0, c1, v):
+            nf, ovf = exchange.global_distinct_frequent(
+                [c0, c1], v, 3, AXIS, 16, seed=5, hier=h,
+                dcn_capacity=64 if h else None)
+            return jnp.stack([jnp.broadcast_to(nf, (N,)),
+                              jnp.broadcast_to(ovf, (N,))])
+        return f
+
+    for prog in (grc, gdf):
+        flat = _run(mesh8, prog(None), cols[0], cols[1], valid)
+        got = _run(mesh8, prog(hier), cols[0], cols[1], valid)
+        np.testing.assert_array_equal(flat, got)
+
+
+def test_exchange_split_bytes_math():
+    # Flat, single host: everything is "ICI", total matches the historical
+    # formula, no reply bytes unless reply lanes exist.
+    ici, dcn, rep = exchange.exchange_split_bytes(8, 1024, 5)
+    assert (ici, dcn, rep) == (exchange.exchange_volume_bytes(8, 1024, 5),
+                               0, 0)
+    # Flat, 2 hosts: of each device's 8 destination rows, 4 are on-host.
+    ici, dcn, rep = exchange.exchange_split_bytes(8, 1024, 5, hosts=2)
+    assert ici == dcn == 8 * 4 * 1024 * 5 * 4
+    assert ici + dcn == exchange.exchange_volume_bytes(8, 1024, 5)
+    # Hierarchical: hop 1 (8x8x cap) is all ICI plus the self-host DCN row;
+    # hop 2 crosses (hosts-1) rows of dcn_capacity per device.
+    ici, dcn, rep = exchange.exchange_split_bytes(8, 1024, 5, hosts=2,
+                                                  hier=True, dcn_capacity=256)
+    assert ici == (8 * 8 * 1024 + 8 * 256) * 5 * 4
+    assert dcn == 8 * 1 * 256 * 5 * 4
+    # Reply lanes add symmetric return traffic and are reported separately.
+    i2, d2, rep = exchange.exchange_split_bytes(8, 1024, 5, hosts=2,
+                                                hier=True, dcn_capacity=256,
+                                                reply_lanes=5)
+    assert (i2, d2) == (2 * ici, 2 * dcn)
+    assert rep == ici + dcn
+
+
+def test_log_exchange_split_columns():
+    stats: dict = {}
+    exchange.log_exchange(stats, "x", num_dev=8, capacity=256, lanes=3,
+                          hosts=2, hier=True, dcn_capacity=64, reply_lanes=1)
+    exchange.log_exchange(stats, "x", num_dev=8, capacity=256, lanes=3,
+                          hosts=2, hier=True, dcn_capacity=64, reply_lanes=1)
+    e = stats["exchange_sites"]["x"]
+    assert e["bytes"] == e["ici_bytes"] + e["dcn_bytes"]
+    assert e["dcn_bytes"] > 0 and e["reply_bytes"] > 0
+    assert e["hier"] == 1 and e["dcn_capacity"] == 64
+    assert e["reply_lanes"] == 1
+    ici1, dcn1, rep1 = exchange.exchange_split_bytes(
+        8, 256, 3, hosts=2, hier=True, dcn_capacity=64, reply_lanes=1)
+    assert e["ici_bytes"] == 2 * ici1
+    assert e["dcn_bytes"] == 2 * dcn1
+    assert e["reply_bytes"] == 2 * rep1

@@ -1,0 +1,170 @@
+"""Pipeline-level hierarchical exchange: knob behavior, strategy parity,
+and the DCN byte reduction on the 2-host proxy.
+
+`RDFIND_HIER_HOSTS=2` models a 2-host pod on the 8 fake CPU devices (the
+same proxy MULTICHIP_r05.json used), and `RDFIND_HIER_EXCHANGE` flips the
+two-level path on/off.  The acceptance bar: every sharded strategy's CIND
+rows are bit-identical across knob settings, the hierarchical path moves
+at least 2x fewer inter-host bytes on a skewed workload, and knob=0
+restores the flat path's exchange ledger exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from rdfind_tpu.models import sharded
+from rdfind_tpu.parallel.mesh import hier_spec, make_mesh, topology_hosts
+from rdfind_tpu.utils.synth import generate_triples
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 CPU devices"
+    return make_mesh(8)
+
+
+@pytest.fixture
+def hier_env(monkeypatch):
+    """2-host proxy with the hierarchical path forced on."""
+    monkeypatch.setenv("RDFIND_HIER_HOSTS", "2")
+    monkeypatch.setenv("RDFIND_HIER_EXCHANGE", "1")
+    return monkeypatch
+
+
+def _rows(table):
+    return sorted(map(tuple, table.to_rows()))
+
+
+def test_hier_spec_resolution(monkeypatch):
+    monkeypatch.delenv("RDFIND_HIER_EXCHANGE", raising=False)
+    monkeypatch.delenv("RDFIND_HIER_HOSTS", raising=False)
+    # auto on one process: flat (the two-level path has no DCN to save).
+    assert hier_spec(8) is None
+    monkeypatch.setenv("RDFIND_HIER_HOSTS", "2")
+    assert topology_hosts(8) == 2
+    assert hier_spec(8) == (2, 4)  # auto + 2 hosts: hierarchical
+    monkeypatch.setenv("RDFIND_HIER_EXCHANGE", "0")
+    assert hier_spec(8) is None  # forced flat wins over the host count
+    monkeypatch.setenv("RDFIND_HIER_EXCHANGE", "1")
+    assert hier_spec(8) == (2, 4)
+    # A host count that does not divide the mesh degenerates to flat.
+    monkeypatch.setenv("RDFIND_HIER_HOSTS", "3")
+    assert topology_hosts(8) == 1
+    monkeypatch.setenv("RDFIND_HIER_EXCHANGE", "auto")
+    assert hier_spec(8) is None
+
+
+STRATEGIES = [
+    ("all_at_once", sharded.discover_sharded),
+    ("s2l", sharded.discover_sharded_s2l),
+    ("approx", sharded.discover_sharded_approx),
+    ("late_bb", sharded.discover_sharded_late_bb),
+]
+
+
+@pytest.mark.parametrize("name,fn", STRATEGIES)
+def test_strategies_bit_identical_across_knob(mesh8, monkeypatch, name, fn):
+    triples = generate_triples(400, seed=21, n_predicates=8, n_entities=32)
+    monkeypatch.setenv("RDFIND_HIER_HOSTS", "2")
+    monkeypatch.setenv("RDFIND_HIER_EXCHANGE", "0")
+    flat = _rows(fn(triples, 2, mesh=mesh8, use_fis=True))
+    monkeypatch.setenv("RDFIND_HIER_EXCHANGE", "1")
+    hier = _rows(fn(triples, 2, mesh=mesh8, use_fis=True))
+    assert flat == hier
+    assert len(flat) > 0
+
+
+def test_dcn_bytes_reduced_2x_on_skewed_workload(mesh8, monkeypatch):
+    """The pre-aggregating path must at least halve inter-host traffic on
+    the zipf-skewed generator (hub join values duplicate candidate rows
+    across every device of a host — exactly what the combiner removes)."""
+    triples = generate_triples(400, seed=21, n_predicates=8, n_entities=32)
+    monkeypatch.setenv("RDFIND_HIER_HOSTS", "2")
+
+    def run(knob):
+        monkeypatch.setenv("RDFIND_HIER_EXCHANGE", knob)
+        stats: dict = {}
+        table = sharded.discover_sharded(triples, 2, mesh=mesh8,
+                                         use_fis=True, stats=stats)
+        return _rows(table), stats["exchange_sites"]
+
+    rows_flat, flat = run("0")
+    rows_hier, hier = run("1")
+    assert rows_flat == rows_hier
+    dcn_flat = sum(e["dcn_bytes"] for e in flat.values())
+    dcn_hier = sum(e["dcn_bytes"] for e in hier.values())
+    assert dcn_flat >= 2 * dcn_hier, (dcn_flat, dcn_hier)
+    # Every ledger entry stays internally consistent in both modes.
+    for sites in (flat, hier):
+        for e in sites.values():
+            assert e["bytes"] == e["ici_bytes"] + e["dcn_bytes"]
+    # The combining sites flipped hierarchical; the slot-preserving and
+    # gather sites are attributed but unchanged.
+    for site in ("freq", "exchange_a", "exchange_b", "exchange_c"):
+        assert hier[site]["hier"] == 1
+        assert hier[site]["dcn_capacity"] > 0
+    assert hier["giant_gather"]["hier"] == 0
+
+
+def test_knob_off_restores_flat_ledger_exactly(mesh8, monkeypatch):
+    """RDFIND_HIER_EXCHANGE=0 must be indistinguishable from a plain
+    single-host run except for byte *attribution* (the 2-host proxy knows
+    half the flat traffic crosses DCN; totals and capacities match)."""
+    triples = generate_triples(300, seed=7, n_predicates=8, n_entities=32)
+
+    def run():
+        stats: dict = {}
+        table = sharded.discover_sharded(triples, 2, mesh=mesh8,
+                                         use_fis=True, stats=stats)
+        return _rows(table), stats["exchange_sites"]
+
+    monkeypatch.delenv("RDFIND_HIER_EXCHANGE", raising=False)
+    monkeypatch.delenv("RDFIND_HIER_HOSTS", raising=False)
+    rows_ref, ref = run()
+    monkeypatch.setenv("RDFIND_HIER_HOSTS", "2")
+    monkeypatch.setenv("RDFIND_HIER_EXCHANGE", "0")
+    rows_off, off = run()
+    assert rows_ref == rows_off
+    assert set(ref) == set(off)
+    for site in ref:
+        for col in ("calls", "capacity", "lanes", "bytes", "rows_capacity",
+                    "overflow_retries", "reply_bytes", "reply_lanes",
+                    "dcn_capacity", "hier"):
+            assert ref[site][col] == off[site][col], (site, col)
+        # Attribution differs: single-host counts everything as ICI.
+        assert ref[site]["dcn_bytes"] == 0
+        assert (off[site]["ici_bytes"] + off[site]["dcn_bytes"]
+                == ref[site]["ici_bytes"])
+
+
+def test_dcn_chunks_bit_identical(mesh8, hier_env):
+    triples = generate_triples(300, seed=11, n_predicates=8, n_entities=32)
+    base = _rows(sharded.discover_sharded(triples, 2, mesh=mesh8,
+                                          use_fis=True))
+    hier_env.setenv("RDFIND_HIER_DCN_CHUNKS", "2")
+    got = _rows(sharded.discover_sharded(triples, 2, mesh=mesh8,
+                                         use_fis=True))
+    assert base == got
+
+
+def test_hier_survives_injected_overflow(mesh8, hier_env):
+    """The grow-retry ladder handles hierarchical sites (both hop budgets
+    grow together) and still converges to the flat answer."""
+    from rdfind_tpu.runtime import faults
+    triples = generate_triples(300, seed=11, n_predicates=8, n_entities=32)
+    hier_env.setenv("RDFIND_HIER_EXCHANGE", "0")
+    ref = _rows(sharded.discover_sharded(triples, 2, mesh=mesh8))
+    hier_env.setenv("RDFIND_HIER_EXCHANGE", "1")
+    hier_env.setenv("RDFIND_FAULTS", "overflow@captures:nth=1")
+    hier_env.setenv("RDFIND_BACKOFF_BASE_MS", "1")
+    faults.reset()
+    try:
+        stats: dict = {}
+        got = _rows(sharded.discover_sharded(triples, 2, mesh=mesh8,
+                                             stats=stats))
+        assert stats["exchange_sites"]["exchange_b"]["overflow_retries"] >= 1
+    finally:
+        faults.reset()
+    assert got == ref

@@ -60,6 +60,31 @@ def test_two_process_discovery_s2l():
     assert got == want
 
 
+@pytest.mark.slow
+def test_two_process_hierarchical_exchange():
+    """Hierarchical vs flat exchange across REAL process boundaries: the
+    worker pair runs both knob settings on one runtime and reports rows +
+    the per-site dcn_bytes ledgers.  Bit-identical CINDs, strictly lower
+    inter-host volume, and auto-resolution from jax.process_count()==2."""
+    port = _free_port()
+    worker = os.path.join(_REPO, "tests", "multihost_worker.py")
+    outs = _run_procs(
+        [[sys.executable, worker, str(pid), "2", str(port), "hier"]
+         for pid in range(2)], _cpu_env())
+    lines = dict(l.split(" ", 1) for l in outs[0][0].splitlines()
+                 if l.startswith(("ROWS ", "ROWS_HIER", "DCN")))
+    flat_rows = json.loads(lines["ROWS"])
+    hier_rows = json.loads(lines["ROWS_HIER"])
+    assert flat_rows == hier_rows and len(flat_rows) > 0
+    assert [tuple(r) for r in flat_rows] == [tuple(r) for r in _golden("0")]
+    dcn_flat, dcn_hier = json.loads(lines["DCN"])
+    assert sum(dcn_hier.values()) < sum(dcn_flat.values()), (dcn_flat,
+                                                            dcn_hier)
+    # The combining sites individually moved fewer inter-host bytes.
+    for site in ("freq", "exchange_a", "exchange_b", "exchange_c"):
+        assert dcn_hier[site] < dcn_flat[site], site
+
+
 NT_SHARDS = [
     "<alice> <knows> <bob> .\n<bob> <knows> <carol> .\n",
     "<carol> <knows> <alice> .\n<alice> <likes> <bob> .\n",
