@@ -28,6 +28,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from rdfind_tpu import obs  # noqa: E402
 from rdfind_tpu.obs import report as obs_report  # noqa: E402
+from rdfind_tpu.obs import sentinel as obs_sentinel  # noqa: E402
 
 
 def _probe_tpu_subprocess(timeout_s: int) -> tuple[bool, str]:
@@ -381,6 +382,10 @@ def _bench_pipelined_passes(min_support: int) -> dict:
                 # a degraded run degraded, and a clean one didn't, straight
                 # from the artifact.
                 **obs_report.dispatch_row(stats),
+                # The overlap-efficiency row (dispatch.overlap_report):
+                # measured wall vs the serial/parallel bounds — sync mode
+                # should meter ~0 efficiency, pipelined mode the real win.
+                "overlap": stats.get("overlap"),
                 "degradations": stats.get("degradations"),
                 "ladder_rung": stats.get("ladder_rung"),
                 "cinds": len(tables[mode]),
@@ -418,7 +423,8 @@ def _bench_exchange(min_support: int) -> dict:
     num_dev = int(mesh.devices.size)
     out = {"n_devices": num_dev, "n_triples": n}
     saved = {k: os.environ.get(k)
-             for k in ("RDFIND_HIER_EXCHANGE", "RDFIND_HIER_HOSTS")}
+             for k in ("RDFIND_HIER_EXCHANGE", "RDFIND_HIER_HOSTS",
+                       "RDFIND_COLLECTIVE_TIMING", "RDFIND_LINK_PROBE")}
     try:
         if topology_hosts(num_dev) == 1 and num_dev % 2 == 0:
             os.environ["RDFIND_HIER_HOSTS"] = "2"  # single-host pod proxy
@@ -457,6 +463,39 @@ def _bench_exchange(min_support: int) -> dict:
                                     == tables["hier"].to_rows())
         out["dcn_reduction"] = round(
             rows["flat"]["dcn_bytes"] / max(rows["hier"]["dcn_bytes"], 1), 3)
+        # Per-site collective timing (hier mode, timers + link probe armed):
+        # device-synchronized wall per dispatch, achieved GB/s and
+        # utilization of the probed per-hop peaks.  The per-hop achieved
+        # rates follow from attributing each dispatch's wall to its hops in
+        # proportion to their ideal transfer times: achieved_hop =
+        # peak_hop * link_util.
+        from rdfind_tpu.obs import metrics as obs_metrics
+
+        os.environ["RDFIND_COLLECTIVE_TIMING"] = "1"
+        os.environ["RDFIND_LINK_PROBE"] = "1"
+        stats = {}
+        timed_tbl = sharded.discover_sharded(triples, min_support, mesh=mesh,
+                                             use_fis=True, stats=stats)
+        caps = obs_metrics.link_caps()
+        t_sites = {}
+        for s, e in sorted(stats["exchange_sites"].items()):
+            if "wall_ms" not in e:
+                continue
+            row = {k: e[k] for k in ("wall_ms", "gbps", "link_util",
+                                     "timed_calls", "timed_bytes", "ideal_ms")
+                   if k in e}
+            util = e.get("link_util") or 0.0
+            if caps.get("ici_gbps"):
+                row["ici_gbps"] = round(caps["ici_gbps"] * util, 3)
+            if caps.get("dcn_gbps") and e.get("dcn_bytes"):
+                row["dcn_gbps"] = round(caps["dcn_gbps"] * util, 3)
+            t_sites[s] = row
+        out["timing"] = {
+            "link_caps": caps,
+            "sites": t_sites,
+            # The timers are pure measurement: armed vs unarmed must agree.
+            "outputs_identical": timed_tbl.to_rows() == tables["hier"].to_rows(),
+        }
     finally:
         for k, v in saved.items():
             if v is None:
@@ -578,6 +617,9 @@ def _run(n: int, min_support: int) -> dict:
     detail = {
         "backend": backend,
         **fallback_extra,
+        # Row identity for the regression sentinel: git sha, core count and
+        # the resolved RDFIND_* knob set this run measured under.
+        "provenance": obs_sentinel.provenance(backend=backend),
         "n_triples": n, "min_support": min_support,
         "wall_s": round(elapsed, 3), "total_pairs": stats["total_pairs"],
         "n_lines": stats["n_lines"], "max_line": stats["max_line"],
@@ -730,6 +772,22 @@ def _run(n: int, min_support: int) -> dict:
     }
 
 
+def _record_history(result: dict) -> None:
+    """Append this run to the sentinel's BENCH_HISTORY.jsonl (stderr-only
+    reporting: stdout stays the single JSON result line).  BENCH_HISTORY
+    overrides the path; "0" disables."""
+    dest = os.environ.get("BENCH_HISTORY", "")
+    if dest == "0":
+        return
+    try:
+        row = obs_sentinel.append(result, path=dest or None)
+        print(f"bench: history row appended (sha={row['sha']}, "
+              f"{len(row['metrics'])} metrics)", file=sys.stderr, flush=True)
+    except Exception as e:  # history is telemetry, never a bench failure
+        print(f"bench: history append failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+
+
 def main():
     n = int(os.environ.get("BENCH_TRIPLES", 200_000))
     min_support = int(os.environ.get("BENCH_MIN_SUPPORT", 10))
@@ -753,6 +811,7 @@ def main():
                       "unit": "triples/s", "vs_baseline": 0,
                       "detail": {"error": f"{type(e).__name__}: {e}"}}
         print(json.dumps(result))
+        _record_history(result)
         return
     try:
         result = _run(n, min_support)
@@ -767,6 +826,7 @@ def main():
                        "traceback": tb.splitlines()[-3:]},
         }
     print(json.dumps(result))
+    _record_history(result)
 
 
 if __name__ == "__main__":

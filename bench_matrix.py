@@ -175,8 +175,13 @@ def main():
     # The axon tunnel can wedge (block inside a C call); use bench.py's
     # killable-subprocess probe + CPU fallback so the matrix always reports.
     from bench import _init_backend
+    from rdfind_tpu.obs import sentinel as obs_sentinel
     backend = _init_backend()
     print(f"backend: {backend}", file=sys.stderr)
+    # Shared row identity (git sha, core count, knob set): resolved once —
+    # run_one's own env overrides are per-cell parameters already recorded in
+    # the row, not ambient provenance.
+    prov = obs_sentinel.provenance(backend=backend)
 
     rows = []
     for cid in (int(c) for c in args.configs.split(",")):
@@ -200,6 +205,7 @@ def main():
                                        "hier": hier.strip(),
                                        "error": f"{type(e).__name__}: {e}"}
                             row["backend"] = backend
+                            row["provenance"] = prov
                             rows.append(row)
                             print(json.dumps(row), flush=True)
 

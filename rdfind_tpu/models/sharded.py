@@ -72,6 +72,7 @@ import functools
 import hashlib
 import os
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -85,9 +86,11 @@ from ..ops.emission import emit_join_candidates
 from ..obs import memory as obs_memory
 from ..obs import metrics, tracer
 from ..parallel import exchange
-from ..parallel.mesh import (AXIS, dcn_chunks as env_dcn_chunks, hier_spec,
+from ..parallel.mesh import (AXIS, allgather_host_values,
+                             dcn_chunks as env_dcn_chunks, hier_spec,
                              host_gather, host_gather_many, make_global,
-                             make_mesh, shard_map, topology_hosts)
+                             make_mesh, maybe_link_probe, shard_map,
+                             topology_hosts)
 from ..runtime import dispatch, faults
 
 SENTINEL = segments.SENTINEL
@@ -837,6 +840,74 @@ _LANES_EXCHANGE_C = 8   # 6 pair-key cols + count + validity
 _LANES_GIANT = 6        # [jv, code, v1, v2, flag] + validity (all_gather)
 
 
+class _SkewMeter:
+    """Straggler/skew attribution across hosts, one sample per committed pass.
+
+    The paper's scalability argument rests on the bucket shuffles staying
+    balanced across workers; this is the instrument that says when they
+    don't, and WHY.  Each committed pass the executor hands over this host's
+    phase breakdown — exchange (dispatch/enqueue + ledger), compute (the
+    blocking counters pull: dominated by the head pass's device program),
+    pull (the blocks readback), commit (HBM sample + progress snapshot) —
+    and the meter exchanges the 5-float vector across hosts on one tiny
+    allgather (mesh.allgather_host_values; single-process it is a reshape).
+    Per pass it emits trace counter lanes (`host_skew`, `pass_phase_ms`) and
+    registry histograms; at attempt end `publish` lands the run-level
+    ``host_skew`` struct: skew index (slowest host wall / mean wall), the
+    slowest host, and its dominant-phase cause bucket.
+
+    Active only with a live obs consumer or the collective timers armed —
+    the disabled path costs one attribute check per pass.
+    """
+
+    PHASES = ("exchange", "compute", "pull", "commit")
+
+    def __init__(self, stats, what: str):
+        self.active = (tracer.enabled() or metrics.export_requested()
+                       or exchange.collective_timing_enabled())
+        self.stats = stats
+        self.what = what
+        self.totals = np.zeros(len(self.PHASES) + 1)
+        self.n_committed = 0
+
+    def pass_committed(self, phase_ms: dict) -> None:
+        vec = [float(phase_ms.get(ph, 0.0)) for ph in self.PHASES]
+        vec.append(sum(vec))
+        self.totals += np.asarray(vec)
+        self.n_committed += 1
+        m = allgather_host_values(vec)
+        walls = m[:, -1]
+        slowest = int(walls.argmax())
+        skew = float(walls.max() / max(float(walls.mean()), 1e-9))
+        tracer.counter("host_skew", skew=round(skew, 3), slowest=slowest)
+        tracer.counter("pass_phase_ms",
+                       **{ph: round(v, 3)
+                          for ph, v in zip(self.PHASES, vec)})
+        for ph, v in zip(self.PHASES, vec):
+            metrics.observe(f"pass_{ph}_ms", v)
+
+    def publish(self) -> None:
+        """The attempt-level host_skew struct (every host calls this the
+        same number of times — the allgather is a collective)."""
+        if not self.active or not self.n_committed:
+            return
+        m = allgather_host_values(self.totals.tolist())
+        walls = m[:, -1]
+        slowest = int(walls.argmax())
+        cause = self.PHASES[int(np.argmax(m[slowest, :-1]))]
+        metrics.struct_set(self.stats, "host_skew", {
+            "n_hosts": int(m.shape[0]),
+            "n_passes": int(self.n_committed),
+            "skew_index": round(float(walls.max()
+                                      / max(float(walls.mean()), 1e-9)), 4),
+            "slowest_host": slowest,
+            "cause": cause,
+            "per_host_ms": [round(float(x), 3) for x in walls],
+            "phase_ms": {ph: [round(float(x), 3) for x in m[:, i]]
+                         for i, ph in enumerate(self.PHASES)},
+        })
+
+
 class _Pipeline:
     """Planned, retrying execution of the sharded programs (host side).
 
@@ -861,6 +932,15 @@ class _Pipeline:
         self.hier = hier_spec(self.num_dev)
         self.hosts = topology_hosts(self.num_dev)
         self.dcn_chunks = env_dcn_chunks()
+        # One-shot link-capability probe (RDFIND_LINK_PROBE): tiny all_to_all
+        # microbench per hop, cached per topology — the denominator of every
+        # link_util the collective timers report.
+        maybe_link_probe(mesh)
+        # RDFIND_COLLECTIVE_TIMING arms per-dispatch device-synchronized wall
+        # clocks (block_until_ready after every exchange dispatch).  That
+        # serializes the pipelined executor, so it is a measurement mode, not
+        # a flight mode; outputs are bit-identical either way.
+        self._timed = exchange.collective_timing_enabled()
         # Preemption-safe per-pass checkpoints (checkpoint.ProgressStore, or
         # None): each _run_passes phase snapshots committed passes through it.
         self.progress = progress
@@ -896,17 +976,19 @@ class _Pipeline:
         # P2: lines + downstream load measurement (retry on freq/A overflow).
         hier_on = self.hier is not None
         for _ in range(max_retries):
+            pend = []
             if use_fis:
-                exchange.log_exchange(
+                pend.append(exchange.log_exchange(
                     stats, "freq", num_dev=self.num_dev, capacity=self.cap_f,
                     lanes=_LANES_FREQ, reply_lanes=_LANES_FREQ_REPLY,
                     hosts=self.hosts, hier=hier_on,
-                    dcn_capacity=self.cap_f_dcn if hier_on else None)
-            exchange.log_exchange(
+                    dcn_capacity=self.cap_f_dcn if hier_on else None))
+            pend.append(exchange.log_exchange(
                 stats, "exchange_a", num_dev=self.num_dev,
                 capacity=self.cap_a, lanes=_LANES_EXCHANGE_A,
                 hosts=self.hosts, hier=hier_on,
-                dcn_capacity=self.cap_a_dcn if hier_on else None)
+                dcn_capacity=self.cap_a_dcn if hier_on else None))
+            t0 = time.perf_counter() if self._timed else 0.0
             out = _lines_step(
                 self._triples, self._n_valid, jnp.int32(min_support),
                 mesh=mesh, projections=projections, use_fis=use_fis,
@@ -915,6 +997,10 @@ class _Pipeline:
                 cap_freq_dcn=self.cap_f_dcn,
                 cap_exchange_a_dcn=self.cap_a_dcn, hier=self.hier,
                 dcn_chunks=self.dcn_chunks)
+            if self._timed:
+                jax.block_until_ready(out)
+                exchange.log_dispatch_timing(
+                    stats, pend, (time.perf_counter() - t0) * 1e3)
             *line_cols, n_rows, plan, overflow = out
             ovf = host_gather(overflow).reshape(self.num_dev, 2)[0]
             if faults.overflow_injected("overflow@lines"):
@@ -987,16 +1073,21 @@ class _Pipeline:
 
         # P3: capture table (retry on B overflow).
         for _ in range(max_retries):
-            exchange.log_exchange(
+            pend = [exchange.log_exchange(
                 stats, "exchange_b", num_dev=self.num_dev,
                 capacity=self.cap_b,
                 lanes=_LANES_EXCHANGE_B + (1 if hier_on else 0),
                 hosts=self.hosts, hier=hier_on,
-                dcn_capacity=self.cap_b_dcn if hier_on else None)
+                dcn_capacity=self.cap_b_dcn if hier_on else None)]
+            t0 = time.perf_counter() if self._timed else 0.0
             out = _captures_step(*self.lines, self.n_rows, mesh=mesh,
                                  cap_exchange_b=self.cap_b,
                                  cap_exchange_b_dcn=self.cap_b_dcn,
                                  hier=self.hier, dcn_chunks=self.dcn_chunks)
+            if self._timed:
+                jax.block_until_ready(out)
+                exchange.log_dispatch_timing(
+                    stats, pend, (time.perf_counter() - t0) * 1e3)
             *tbl, n_caps, ovf_b = out
             ovf_b = int(host_gather(ovf_b)[0])
             if faults.overflow_injected("overflow@captures"):
@@ -1096,17 +1187,23 @@ class _Pipeline:
         moved_dest = np.zeros(h, np.int32)
         moved_dest[:len(mj)] = md
         for _ in range(self.max_retries):
-            exchange.log_exchange(self.stats, "rebalance",
-                                  num_dev=self.num_dev, capacity=cap_move,
-                                  lanes=_LANES_REBALANCE,
-                                  rows=int(lens[moving].sum()),
-                                  hosts=self.hosts,
-                                  hier=self.hier is not None)
+            pend = [exchange.log_exchange(self.stats, "rebalance",
+                                          num_dev=self.num_dev,
+                                          capacity=cap_move,
+                                          lanes=_LANES_REBALANCE,
+                                          rows=int(lens[moving].sum()),
+                                          hosts=self.hosts,
+                                          hier=self.hier is not None)]
+            t0 = time.perf_counter() if self._timed else 0.0
             out = _rebalance_step(*self.lines, self.n_rows,
                                   moved_jv, moved_dest,
                                   mesh=self.mesh, cap_move=cap_move,
                                   hier=self.hier,
                                   dcn_chunks=self.dcn_chunks)
+            if self._timed:
+                jax.block_until_ready(out)
+                exchange.log_dispatch_timing(
+                    self.stats, pend, (time.perf_counter() - t0) * 1e3)
             *cols, n_rows, ovf = out
             ovf = int(host_gather(ovf)[0])
             if faults.overflow_injected("overflow@rebalance"):
@@ -1313,6 +1410,10 @@ class _Pipeline:
         """One ladder attempt of the pipelined pass loop at the current
         n_pass/caps (see _run_passes for the schedule contract)."""
         d = dispatch.DispatchStats(pull_base=self._pull_base)
+        t_attempt = time.perf_counter()
+        meter = _SkewMeter(self.stats, what)
+        # Phase clock: zero-cost no-op unless a skew consumer is live.
+        now = time.perf_counter if meter.active else (lambda: 0.0)
         parts = [None] * self.n_pass
         teles = [None] * self.n_pass
         tries = [0] * self.n_pass
@@ -1348,6 +1449,7 @@ class _Pipeline:
             head = inflight[0][0] if inflight else p_next
             with tracer.span("pass", cat=tracer.CAT_PASS, what=what,
                              **{"pass": head}):
+                t_fill = now()
                 while p_next < self.n_pass and len(inflight) < depth:
                     if parts[p_next] is not None:  # resumed from a checkpoint
                         p_next += 1
@@ -1360,21 +1462,27 @@ class _Pipeline:
                         # a rollback, so the ledger records dispatches, not
                         # committed passes.
                         hier_on = self.hier is not None
-                        exchange.log_exchange(
+                        pend = [exchange.log_exchange(
                             self.stats, "exchange_c", num_dev=self.num_dev,
                             capacity=self.cap_c, lanes=_LANES_EXCHANGE_C,
                             hosts=self.hosts, hier=hier_on,
-                            dcn_capacity=self.cap_c_dcn if hier_on else None)
+                            dcn_capacity=self.cap_c_dcn if hier_on else None)]
                         # The giant-line all_gather is topology-oblivious
                         # (whole lines replicate everywhere) — hier=False, but
                         # host attribution still splits its ICI/DCN bytes.
-                        exchange.log_exchange(
+                        pend.append(exchange.log_exchange(
                             self.stats, "giant_gather", num_dev=self.num_dev,
                             capacity=min(
                                 self.cap_g,
                                 self.lines[0].shape[0] // self.num_dev),
-                            lanes=_LANES_GIANT, hosts=self.hosts)
+                            lanes=_LANES_GIANT, hosts=self.hosts))
+                        t0 = time.perf_counter() if self._timed else 0.0
                         cols, n_out, tele = step(self._pass_args(p_next))
+                        if self._timed:
+                            jax.block_until_ready((cols, n_out, tele))
+                            exchange.log_dispatch_timing(
+                                self.stats, pend,
+                                (time.perf_counter() - t0) * 1e3)
                         dispatch.stage_to_host([tele])
                     inflight.append((p_next, cols, n_out, tele))
                     p_next += 1
@@ -1382,6 +1490,7 @@ class _Pipeline:
                     break  # everything left was already resumed
                 d.saw_in_flight(len(inflight))
                 p, cols, n_out, tele = inflight.popleft()
+                t_counters = now()
                 tele_h = d.timed_pull(
                     lambda: exchange.unpack_counters(host_gather(tele),
                                                      _TELE_LANES,
@@ -1405,10 +1514,12 @@ class _Pipeline:
                     d.n_cap_retries += 1
                     p_next = p  # resume from the failed pass only
                     continue
+                t_blocks = now()
                 parts[p] = d.timed_pull(
                     lambda: self.collect_blocks(cols, n_out),
                     overlapped=bool(inflight), what="pull-blocks")
                 teles[p] = tuple(int(x) for x in tele_h[_N_OVF:])
+                t_commit = now()
                 if tracer.enabled() or metrics.export_requested():
                     # Per-pass HBM watermark + allocation delta (near-cap
                     # warnings fire BEFORE the ladder has to) — sampled only
@@ -1422,6 +1533,13 @@ class _Pipeline:
                     progress.submit(stage, fp, {
                         i: (parts[i], teles[i]) for i in range(self.n_pass)
                         if parts[i] is not None})
+                if meter.active:
+                    t_end = now()
+                    meter.pass_committed({
+                        "exchange": (t_counters - t_fill) * 1e3,
+                        "compute": (t_blocks - t_counters) * 1e3,
+                        "pull": (t_commit - t_blocks) * 1e3,
+                        "commit": (t_end - t_commit) * 1e3})
                 if faults.fires("preempt@discover", pass_idx=p):
                     if progress is not None:
                         progress.flush()  # the SIGTERM handler's analog
@@ -1432,6 +1550,13 @@ class _Pipeline:
         if self.stats is not None:
             d.publish(self.stats)
             metrics.gauge_set(self.stats, "cap_p_final", self.cap_p)
+            # The overlap-efficiency row of this attempt (the DCN-chunk
+            # autotuner input) and the cross-host skew verdict.
+            metrics.struct_set(
+                self.stats, "overlap",
+                d.overlap_report((time.perf_counter() - t_attempt) * 1e3,
+                                 n_passes=self.n_pass))
+        meter.publish()
         return blocks, tuple(zip(*teles))
 
     def run_cinds(self):

@@ -22,6 +22,11 @@ surface they all publish through:
             formatter behind --debug / -c counter rendering.
   heartbeat the run's liveness/status file (current stage, pass, last-event
             timestamp) so a wedged run is distinguishable from a slow one.
+  flightrec crash-surviving bounded ring of the last N events, dumped
+            atomically on signals, fault-ladder rungs and preemptions — the
+            post-mortem when the jsonl tracer was off.
+  sentinel  the BENCH_HISTORY.jsonl perf series + the noise-aware
+            regression gate (``python -m rdfind_tpu.obs.sentinel --check``).
 
 Import-light by design: every submodule is stdlib-only at import time (jax
 is imported lazily at call sites), so runtime/dispatch.py and
@@ -30,7 +35,8 @@ runtime/faults.py can depend on obs without widening their import footprint.
 
 from __future__ import annotations
 
-from . import heartbeat, memory, metrics, report, tracer  # noqa: F401
+from . import (flightrec, heartbeat, memory, metrics,  # noqa: F401
+               report, sentinel, tracer)
 
 
 def active() -> bool:

@@ -44,16 +44,27 @@ MEMORY_KEYS = ("in_use_bytes", "peak_bytes", "limit_bytes", "frac",
                "delta_bytes")
 
 
-class Histogram:
-    """Fixed-size summary of an observation stream (no per-sample storage)."""
+# Log-bucketed quantile resolution: each bucket spans a ~19% value range
+# (2**0.25), so a reported p50/p95/p99 is within ~10% of the true sample —
+# plenty for latency attribution, at O(distinct magnitudes) memory.
+_HIST_BASE = 2.0 ** 0.25
+_HIST_LOG_BASE = math.log(_HIST_BASE)
+_HIST_UNDERFLOW = -(1 << 30)  # single bucket for values <= 0
+QUANTILES = (0.5, 0.95, 0.99)
 
-    __slots__ = ("count", "total", "min", "max")
+
+class Histogram:
+    """Fixed-size summary of an observation stream (no per-sample storage);
+    sparse log buckets give approximate quantiles."""
+
+    __slots__ = ("count", "total", "min", "max", "_buckets")
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self.min = math.inf
         self.max = -math.inf
+        self._buckets: dict[int, int] = {}
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -61,13 +72,37 @@ class Histogram:
         self.total += value
         self.min = min(self.min, value)
         self.max = max(self.max, value)
+        if value > 0.0:
+            b = math.floor(math.log(value) / _HIST_LOG_BASE)
+        else:
+            b = _HIST_UNDERFLOW
+        self._buckets[b] = self._buckets.get(b, 0) + 1
+
+    def quantile(self, q: float) -> float | None:
+        """Approximate q-quantile from the log buckets (bucket midpoint,
+        clamped to the observed [min, max] so p99 never exceeds max)."""
+        if not self.count:
+            return None
+        rank = q * (self.count - 1)
+        acc = 0
+        for b in sorted(self._buckets):
+            acc += self._buckets[b]
+            if acc > rank:
+                if b == _HIST_UNDERFLOW:
+                    return self.min
+                mid = (_HIST_BASE ** b + _HIST_BASE ** (b + 1)) / 2.0
+                return min(max(mid, self.min), self.max)
+        return self.max
 
     def describe(self) -> dict:
         if not self.count:
             return {"count": 0}
-        return {"count": self.count, "sum": round(self.total, 3),
-                "min": round(self.min, 3), "max": round(self.max, 3),
-                "mean": round(self.total / self.count, 3)}
+        out = {"count": self.count, "sum": round(self.total, 3),
+               "min": round(self.min, 3), "max": round(self.max, 3),
+               "mean": round(self.total / self.count, 3)}
+        for q in QUANTILES:
+            out[f"p{int(q * 100)}"] = round(self.quantile(q), 3)
+        return out
 
 
 class Registry:
@@ -134,6 +169,10 @@ class Registry:
                 h = self._hists[name]
                 base = prefix + _prom_name(name)
                 lines.append(f"# TYPE {base} summary")
+                for q in QUANTILES:
+                    v = h.quantile(q)
+                    if v is not None:
+                        lines.append(f'{base}{{quantile="{q}"}} {v}')
                 lines.append(f"{base}_count {h.count}")
                 lines.append(f"{base}_sum {h.total}")
         return "\n".join(lines) + "\n"
@@ -330,3 +369,30 @@ def restore(stats: dict | None, decoded: dict) -> None:
 def observe(name: str, value: float) -> None:
     """Registry-only histogram observation (no legacy key)."""
     _REGISTRY.observe(name, value)
+
+
+# ---------------------------------------------------------------------------
+# Link-capability cache (the one-shot startup probe, parallel/mesh.link_probe).
+# Lives here — not in mesh — so the exchange timers can read the probed peaks
+# without an import cycle, and survives registry resets within the process
+# (the probe is one-shot per topology; a mid-run reset must not orphan it).
+# ---------------------------------------------------------------------------
+
+_LINK_CAPS: dict = {}
+
+
+def set_link_caps(caps: dict) -> None:
+    """Record the probed per-hop peaks ({"ici_gbps", "dcn_gbps", ...}) and
+    mirror them into the registry for snapshots/Prometheus."""
+    _LINK_CAPS.clear()
+    _LINK_CAPS.update(caps)
+    struct_set(None, "link_caps", dict(caps))
+
+
+def link_caps() -> dict:
+    """The probed per-hop peaks, or {} when no probe has run."""
+    return dict(_LINK_CAPS)
+
+
+def clear_link_caps() -> None:
+    _LINK_CAPS.clear()

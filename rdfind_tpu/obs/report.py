@@ -181,6 +181,12 @@ def format_debug_lines(stats: dict) -> list[str]:
         # per site, split by interconnect tier (intra-host ICI vs inter-host
         # DCN) — the input to multi-chip bandwidth projections.
         for site, e in sorted(stats["exchange_sites"].items()):
+            # Timing keys exist only under RDFIND_COLLECTIVE_TIMING — the
+            # suffix is additive so untimed runs render the historical line.
+            timing = ""
+            if "wall_ms" in e:
+                timing = (f" wall_ms={e['wall_ms']} gbps={e.get('gbps', 0)} "
+                          f"link_util={e.get('link_util', 0)}")
             lines.append(
                 f"exchange[{site}]: calls={e['calls']} "
                 f"capacity={e['capacity']} lanes={e['lanes']} "
@@ -189,7 +195,25 @@ def format_debug_lines(stats: dict) -> list[str]:
                 f"reply_bytes={e.get('reply_bytes', 0)} "
                 f"hier={e.get('hier', 0)} "
                 f"rows_capacity={e['rows_capacity']} "
-                f"overflow_retries={e['overflow_retries']}")
+                f"overflow_retries={e['overflow_retries']}" + timing)
+    if "overlap" in stats:
+        # The overlap-efficiency row: where the measured wall sits between
+        # the no-overlap and perfect-overlap bounds (dispatch.overlap_report).
+        ov = stats["overlap"]
+        lines.append(
+            f"overlap: passes={ov['n_passes']} "
+            f"measured_ms={ov['measured_ms']} pull_ms={ov['pull_ms']} "
+            f"overlap_ms={ov['overlap_ms']} "
+            f"serial_bound_ms={ov['serial_bound_ms']} "
+            f"parallel_bound_ms={ov['parallel_bound_ms']} "
+            f"efficiency={ov['overlap_efficiency']}")
+    if "host_skew" in stats:
+        # Straggler verdict: slowest host, how much slower, and which phase.
+        hs = stats["host_skew"]
+        lines.append(
+            f"host skew: hosts={hs['n_hosts']} passes={hs['n_passes']} "
+            f"skew_index={hs['skew_index']} "
+            f"slowest_host={hs['slowest_host']} cause={hs['cause']}")
     if "dense_plan" in stats:
         # Dense cooc occupancy: the roofline-correcting record (issued vs
         # real FLOPs of the scheduled tile sweep) plus the resolved dtype.

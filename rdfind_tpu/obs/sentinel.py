@@ -1,0 +1,252 @@
+"""Perf-regression sentinel: BENCH_HISTORY.jsonl + a noise-aware gate.
+
+The bench trajectory used to live in loose one-off artifacts
+(BENCH_r01…r05), so a regression only surfaced when a human re-read them
+side by side.  The sentinel makes the trajectory a first-class series:
+
+* :func:`append` flattens one bench.py result line into a history row —
+  headline throughput plus the kernel/pipeline/exchange detail walls —
+  keyed by provenance (git sha, n_cores, backend, resolved RDFIND_* knob
+  set) and appends it to ``BENCH_HISTORY.jsonl``.
+* :func:`check` compares the newest row against a trailing baseline of
+  rows with the SAME (n_cores, backend, knobs) key — sha may differ; that
+  is the axis under test — and flags a metric when it is worse than the
+  baseline median by more than a threshold factor AND worse than the
+  baseline's own observed spread explains.  Exit is nonzero on regression,
+  so ``python -m rdfind_tpu.obs.sentinel --check`` gates CI
+  (scripts/verify.sh wires it behind the tier-1 suite).
+
+Noise awareness: with a single baseline row only the ratio test applies;
+with more rows the worst historical ratio (max/median) widens the gate, so
+a machine whose tiny-bench legitimately wobbles 1.4x does not page at the
+default 1.5x threshold while a planted 2x slowdown still trips it.
+
+Knobs: ``RDFIND_SENTINEL_THRESHOLD`` (worse-than-median factor, default
+1.5) and ``RDFIND_SENTINEL_WINDOW`` (trailing baseline rows, default 5).
+
+Stdlib-only (the obs contract); bench.py calls :func:`append` after every
+run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HISTORY_FILE = "BENCH_HISTORY.jsonl"
+DEFAULT_THRESHOLD = 1.5
+DEFAULT_WINDOW = 5
+
+# (metric name, path into the bench result, direction).  "lower" = wall
+# times (regression is bigger), "higher" = throughput (regression is
+# smaller).  Paths that a run did not produce are simply absent from its
+# row; check() only compares metrics present on both sides.
+METRIC_SPECS = (
+    ("headline_pairs_per_sec_per_chip", ("value",), "higher"),
+    ("headline_wall_s", ("detail", "wall_s"), "lower"),
+    ("s2l_wall_s", ("detail", "s2l", "wall_s"), "lower"),
+    ("approx_wall_s", ("detail", "approx", "wall_s"), "lower"),
+    ("pipelined_wall_s",
+     ("detail", "pipelined_passes", "pipelined", "wall_s"), "lower"),
+    ("sync_wall_s", ("detail", "pipelined_passes", "sync", "wall_s"),
+     "lower"),
+    ("exchange_flat_wall_s", ("detail", "exchange", "flat", "wall_s"),
+     "lower"),
+    ("exchange_hier_wall_s", ("detail", "exchange", "hier", "wall_s"),
+     "lower"),
+)
+_DIRECTIONS = {name: d for name, _, d in METRIC_SPECS}
+
+
+def _git_sha() -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            capture_output=True, text=True, timeout=10)
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else None
+    except Exception:
+        return None
+
+
+def resolved_knobs() -> dict[str, str]:
+    """The RDFIND_* env as this process sees it — the knob half of a
+    history row's identity (two rows with different knobs never compare)."""
+    return {k: os.environ[k] for k in sorted(os.environ)
+            if k.startswith("RDFIND_")}
+
+
+def provenance(backend: str | None = None) -> dict:
+    """The identity fields every bench row carries: git sha, core count,
+    backend, and the resolved knob set."""
+    return {"sha": _git_sha(), "n_cores": os.cpu_count(),
+            "backend": backend, "knobs": resolved_knobs()}
+
+
+def _dig(result: dict, path: tuple):
+    cur = result
+    for p in path:
+        if not isinstance(cur, dict) or p not in cur:
+            return None
+        cur = cur[p]
+    return cur
+
+
+def extract_metrics(result: dict) -> dict[str, float]:
+    out = {}
+    for name, path, _direction in METRIC_SPECS:
+        v = _dig(result, path)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
+            out[name] = float(v)
+    return out
+
+
+def build_row(result: dict, backend: str | None = None) -> dict:
+    if backend is None:
+        backend = _dig(result, ("detail", "backend"))
+    row = {"ts": round(time.time(), 3), **provenance(backend=backend),
+           "metrics": extract_metrics(result)}
+    return row
+
+
+def default_history_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), HISTORY_FILE)
+
+
+def append(result: dict, path: str | None = None,
+           backend: str | None = None) -> dict:
+    """Append one bench result as a history row; returns the row."""
+    row = build_row(result, backend=backend)
+    path = path or default_history_path()
+    with open(path, "a") as f:
+        f.write(json.dumps(row, default=str) + "\n")
+    return row
+
+
+def load_history(path: str | None = None) -> list[dict]:
+    path = path or default_history_path()
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail line (a killed bench) is not fatal
+    except OSError:
+        pass
+    return rows
+
+
+def _row_key(row: dict) -> str:
+    return json.dumps([row.get("n_cores"), row.get("backend"),
+                       row.get("knobs", {})], sort_keys=True, default=str)
+
+
+def check(path: str | None = None, threshold: float | None = None,
+          window: int | None = None) -> tuple[bool, list[str]]:
+    """(ok, report_lines): newest row vs the trailing same-key baseline."""
+    if threshold is None:
+        threshold = float(os.environ.get("RDFIND_SENTINEL_THRESHOLD",
+                                         str(DEFAULT_THRESHOLD)))
+    if window is None:
+        window = int(os.environ.get("RDFIND_SENTINEL_WINDOW",
+                                    str(DEFAULT_WINDOW)))
+    rows = load_history(path)
+    if not rows:
+        return True, ["sentinel: no history rows — nothing to check"]
+    newest = rows[-1]
+    key = _row_key(newest)
+    baseline = [r for r in rows[:-1] if _row_key(r) == key][-window:]
+    if not baseline:
+        return True, [f"sentinel: no baseline rows match "
+                      f"(n_cores={newest.get('n_cores')}, "
+                      f"backend={newest.get('backend')}) — pass by default"]
+    lines = [f"sentinel: newest sha={newest.get('sha')} vs "
+             f"{len(baseline)} baseline row(s), threshold {threshold}x"]
+    regressions = []
+    for name, value in sorted(newest.get("metrics", {}).items()):
+        hist = [r["metrics"][name] for r in baseline
+                if isinstance(r.get("metrics", {}).get(name), (int, float))]
+        if not hist or value <= 0:
+            continue
+        hist.sort()
+        median = hist[len(hist) // 2]
+        if median <= 0:
+            continue
+        # worse-ratio > 1 means this row regressed vs the median.
+        if _DIRECTIONS[name] == "lower":
+            worse = value / median
+            spread = max(hist) / median
+        else:
+            worse = median / value
+            spread = median / min(hist)
+        # Noise-aware gate: the baseline's own worst wobble (plus 10%
+        # margin) widens the threshold — a jittery metric needs a bigger
+        # excursion to page than a historically stable one.
+        gate = max(threshold, spread * 1.1)
+        verdict = "REGRESSION" if worse > gate else "ok"
+        lines.append(f"  {name}: {value} vs median {median} "
+                     f"(worse-ratio {worse:.3f}, gate {gate:.3f}) {verdict}")
+        if worse > gate:
+            regressions.append(name)
+    if regressions:
+        lines.append(f"sentinel: REGRESSION in {', '.join(regressions)}")
+        return False, lines
+    lines.append("sentinel: ok")
+    return True, lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m rdfind_tpu.obs.sentinel",
+        description="Append bench.py result lines to BENCH_HISTORY.jsonl "
+                    "and gate on noise-aware regression thresholds.")
+    ap.add_argument("--check", action="store_true",
+                    help="compare the newest history row against the "
+                         "trailing baseline; exit 1 on regression")
+    ap.add_argument("--append", metavar="FILE", default=None,
+                    help="append the bench JSON line in FILE ('-' = stdin)")
+    ap.add_argument("--history", default=None,
+                    help=f"history path (default: repo {HISTORY_FILE})")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="worse-than-median factor that flags a regression "
+                         f"(default {DEFAULT_THRESHOLD} or "
+                         "RDFIND_SENTINEL_THRESHOLD)")
+    ap.add_argument("--window", type=int, default=None,
+                    help=f"trailing baseline rows (default {DEFAULT_WINDOW} "
+                         "or RDFIND_SENTINEL_WINDOW)")
+    args = ap.parse_args(argv)
+    did = False
+    if args.append is not None:
+        text = (sys.stdin.read() if args.append == "-"
+                else open(args.append).read())
+        result = json.loads(text.strip().splitlines()[-1])
+        row = append(result, path=args.history)
+        print(f"sentinel: appended row sha={row['sha']} "
+              f"metrics={sorted(row['metrics'])}")
+        did = True
+    if args.check:
+        ok, lines = check(path=args.history, threshold=args.threshold,
+                          window=args.window)
+        print("\n".join(lines))
+        return 0 if ok else 1
+    if not did:
+        ap.print_help()
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -30,7 +30,7 @@ import os
 import threading
 import time
 
-from . import heartbeat
+from . import flightrec, heartbeat
 
 # Span categories (the `cat` field of every event) — the fixed vocabulary the
 # report tool and the tests nest-check against.
@@ -123,6 +123,8 @@ class Tracer:
         return st
 
     def _emit(self, ev: dict) -> None:
+        if flightrec._ENABLED:
+            flightrec.record(ev)
         line = json.dumps(ev, separators=(",", ":"), default=str)
         with self._lock:
             self._f.write(line + "\n")
@@ -221,6 +223,7 @@ def start(directory: str, host_index: int | None = None) -> Tracer:
         except Exception:
             pass
     _TRACER = Tracer(directory, host_index=host_index)
+    flightrec.set_host(host_index)
     _ENABLED = True
     return _TRACER
 
@@ -235,8 +238,13 @@ def stop() -> None:
 
 def span(name: str, cat: str = CAT_STAGE, **args):
     """Open a span (context manager).  The disabled path returns a shared
-    no-op object after one global check."""
+    no-op object after one global check (plus one flight-recorder flag
+    check — the ring records span opens even when the jsonl tracer is off,
+    so post-mortem dumps exist for untraced runs)."""
     if not _ENABLED:
+        if flightrec._ENABLED:
+            flightrec.record({"name": name, "cat": cat, "ph": "B",
+                              "ts": time.time_ns() // 1000, "args": args})
         return _NULL_SPAN
     return _TRACER.open_span(name, cat, args)
 
@@ -244,11 +252,17 @@ def span(name: str, cat: str = CAT_STAGE, **args):
 def instant(name: str, cat: str = CAT_EXCHANGE, **args) -> None:
     """A zero-duration event (e.g. one exchange dispatch's ledger entry)."""
     if not _ENABLED:
+        if flightrec._ENABLED:
+            flightrec.record({"name": name, "cat": cat, "ph": "i",
+                              "ts": time.time_ns() // 1000, "args": args})
         return
     _TRACER.instant(name, cat, args)
 
 
 def counter(name: str, **values) -> None:
     if not _ENABLED:
+        if flightrec._ENABLED:
+            flightrec.record({"name": name, "ph": "C",
+                              "ts": time.time_ns() // 1000, "args": values})
         return
     _TRACER.counter(name, values)

@@ -34,6 +34,7 @@ All functions assume they run inside shard_map over a 1-D mesh axis.
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -111,13 +112,19 @@ def log_exchange(stats, site: str, *, num_dev: int, capacity: int,
     `hosts`/`hier`/`dcn_capacity`/`reply_lanes` drive the ICI/DCN split
     (exchange_split_bytes): `bytes` stays the grand total (forward + reply,
     both links) and always equals ici_bytes + dcn_bytes.
+
+    Returns this dispatch's byte attribution ({site, bytes, ici, dcn,
+    reply}) so a caller timing the dispatch can hand it straight to
+    log_dispatch_timing.
     """
-    if stats is None:
-        return
     ici1, dcn1, reply1 = exchange_split_bytes(
         num_dev, capacity, lanes, hosts=hosts, hier=hier,
         dcn_capacity=dcn_capacity, reply_lanes=reply_lanes)
     nbytes = calls * (ici1 + dcn1)
+    split = {"site": site, "bytes": nbytes, "ici": calls * ici1,
+             "dcn": calls * dcn1, "reply": calls * reply1}
+    if stats is None:
+        return split
 
     def fn(c):
         e = c.setdefault("exchange_sites", {}).setdefault(
@@ -142,6 +149,72 @@ def log_exchange(stats, site: str, *, num_dev: int, capacity: int,
     tracer.instant("exchange", cat=tracer.CAT_EXCHANGE, site=site,
                    calls=calls, capacity=int(capacity), bytes=nbytes,
                    dcn_bytes=calls * dcn1)
+    return split
+
+
+def collective_timing_enabled() -> bool:
+    """Whether the per-site collective timers are armed
+    (RDFIND_COLLECTIVE_TIMING=1).  Off by default: timing a dispatch means
+    blocking on it (device-synchronized wall), which serializes the
+    pipelined executor — measurement mode, not flight mode.  Outputs are
+    bit-identical either way; only the schedule changes."""
+    return os.environ.get("RDFIND_COLLECTIVE_TIMING", "") not in ("", "0")
+
+
+def log_dispatch_timing(stats, parts, wall_ms: float) -> None:
+    """Attribute one device-synchronized dispatch wall time across the
+    exchange sites it contained.
+
+    `parts` is the list of split dicts the dispatch's log_exchange calls
+    returned (a fused device program can serve several ledger sites — e.g.
+    freq + exchange_a ride one jit); the wall splits across them
+    proportionally to bytes.  Per site the ledger accumulates
+
+      wall_ms      measured wall attributed to this site,
+      timed_calls / timed_bytes   how much of the site's traffic was timed,
+      ideal_ms     the link-transfer lower bound of the timed traffic at the
+                   probed per-hop peaks (mesh.link_probe), and derives
+      gbps         achieved wire throughput (timed_bytes / wall_ms),
+      link_util    ideal_ms / wall_ms — utilization-of-measured-peak; low
+                   means the dispatch was compute- or latency-bound, not
+                   link-bound (absent when no probe ran).
+
+    Per-site histograms (`exchange_<site>_wall_ms`, `exchange_<site>_gbps`)
+    and a trace counter track (`exchange_gbps`) ride along for p50/p95/p99
+    exposition and Perfetto lanes.
+    """
+    parts = [p for p in parts if p]
+    total = sum(p["bytes"] for p in parts)
+    if not parts or total <= 0 or wall_ms <= 0:
+        return
+    caps = metrics.link_caps()
+    ici_peak = caps.get("ici_gbps") or 0.0
+    dcn_peak = caps.get("dcn_gbps") or 0.0
+    for p in parts:
+        share_ms = wall_ms * p["bytes"] / total
+        ideal_ms = 0.0
+        if ici_peak > 0:
+            ideal_ms += p["ici"] / (ici_peak * 1e9) * 1e3
+        if dcn_peak > 0:
+            ideal_ms += p["dcn"] / (dcn_peak * 1e9) * 1e3
+        gbps = p["bytes"] / (share_ms * 1e-3) / 1e9
+
+        def fn(c, p=p, share_ms=share_ms, ideal_ms=ideal_ms):
+            e = c.setdefault("exchange_sites", {}).setdefault(
+                p["site"], _empty_site_entry())
+            e["wall_ms"] = round(e.get("wall_ms", 0.0) + share_ms, 3)
+            e["timed_calls"] = e.get("timed_calls", 0) + 1
+            e["timed_bytes"] = e.get("timed_bytes", 0) + p["bytes"]
+            e["ideal_ms"] = round(e.get("ideal_ms", 0.0) + ideal_ms, 3)
+            wall = e["wall_ms"]
+            e["gbps"] = round(e["timed_bytes"] / (wall * 1e-3) / 1e9, 3)
+            if e["ideal_ms"] > 0:
+                e["link_util"] = round(e["ideal_ms"] / wall, 4)
+
+        metrics.mutate(stats, fn, key="exchange_sites", kind=metrics.STRUCT)
+        metrics.observe(f"exchange_{p['site']}_wall_ms", share_ms)
+        metrics.observe(f"exchange_{p['site']}_gbps", gbps)
+        tracer.counter("exchange_gbps", **{p["site"]: round(gbps, 3)})
 
 
 def log_exchange_retry(stats, site: str) -> None:

@@ -96,6 +96,92 @@ def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
                       check_rep=check_vma)
 
 
+def link_probe_enabled() -> bool:
+    """Whether the startup link-capability probe is armed
+    (RDFIND_LINK_PROBE=1).  Off by default: it costs a few tiny collectives
+    at pipeline start; the exchange timers degrade gracefully without it
+    (achieved GB/s is still reported, utilization-of-peak is not)."""
+    return os.environ.get("RDFIND_LINK_PROBE", "") not in ("", "0")
+
+
+_LINK_PROBE_KEY = None  # (num_dev, hosts) the cached probe ran under
+
+_PROBE_CAP = 1 << 13  # rows per buffer lane: tiny (KBs-MBs), but a full
+_PROBE_REPS = 3       # fixed-shape collective through the real links
+
+
+def _time_a2a(mesh, group: int, cap: int, groups) -> float:
+    """Achieved GB/s of one all_to_all over `groups` (ledger byte
+    convention: every participating device moves its whole (group, cap)
+    int32 buffer, self-rows included — matching exchange_split_bytes so
+    utilization-of-peak compares like with like)."""
+    import time
+
+    from .exchange import _a2a
+
+    num_dev = int(mesh.devices.size)
+    spec = P(AXIS, None)
+    data = make_global(
+        np.zeros((num_dev * group, cap), np.int32), mesh)
+    fn = jax.jit(shard_map(
+        lambda b: _a2a(b, AXIS, groups),
+        mesh=mesh, in_specs=spec, out_specs=spec))
+    jax.block_until_ready(fn(data))  # compile + warm the route
+    t0 = time.perf_counter()
+    for _ in range(_PROBE_REPS):
+        data = fn(data)
+    jax.block_until_ready(data)
+    dt = (time.perf_counter() - t0) / _PROBE_REPS
+    nbytes = num_dev * group * cap * 4
+    return nbytes / max(dt, 1e-9) / 1e9
+
+
+def link_probe(mesh=None, force: bool = False) -> dict:
+    """One-shot per-hop link-capability microbench, cached in the metrics
+    registry (obs/metrics.link_caps).
+
+    Runs a tiny fixed-shape all_to_all per hop of the current topology —
+    intra-host groups (ICI; the full mesh when single-host) and, when the
+    (hosts x local) factorization exists, inter-host groups (DCN) — and
+    records achieved GB/s as the measured peak each exchange timer
+    normalizes against.  Idempotent per (num_dev, hosts): the sharded
+    pipeline calls maybe_link_probe at init and only the first call pays.
+    """
+    global _LINK_PROBE_KEY
+    from ..obs import metrics, tracer
+
+    if mesh is None:
+        mesh = make_mesh()
+    num_dev = int(mesh.devices.size)
+    hosts = topology_hosts(num_dev)
+    key = (num_dev, hosts)
+    if not force and _LINK_PROBE_KEY == key:
+        return metrics.link_caps()
+    from .exchange import hier_groups
+
+    caps = {"num_dev": num_dev, "hosts": hosts, "probe_cap": _PROBE_CAP}
+    if hosts > 1:
+        intra, inter = hier_groups((hosts, num_dev // hosts))
+        caps["ici_gbps"] = round(
+            _time_a2a(mesh, num_dev // hosts, _PROBE_CAP, intra), 3)
+        caps["dcn_gbps"] = round(
+            _time_a2a(mesh, hosts, _PROBE_CAP, inter), 3)
+    else:
+        caps["ici_gbps"] = round(
+            _time_a2a(mesh, num_dev, _PROBE_CAP, None), 3)
+    _LINK_PROBE_KEY = key
+    metrics.set_link_caps(caps)
+    tracer.instant("link_probe", cat=tracer.CAT_EXCHANGE, **caps)
+    return caps
+
+
+def maybe_link_probe(mesh=None) -> dict:
+    """link_probe when armed (the pipeline-init call site); {} otherwise."""
+    if not link_probe_enabled():
+        return {}
+    return link_probe(mesh)
+
+
 _MULTIHOST_INITIALIZED = False
 
 
@@ -205,6 +291,24 @@ def host_gather_many(xs) -> list:
     if jax.process_count() == 1:
         return faults.guarded_pull(lambda: jax.device_get(xs))
     return faults.guarded_pull(lambda: [_host_gather_raw(x) for x in xs])
+
+
+def allgather_host_values(values) -> np.ndarray:
+    """(n_hosts, k) matrix of per-host floats: one tiny DCN allgather under
+    a multi-process runtime, the identity single-process.
+
+    The skew meter rides this each committed pass (per-host wall + phase
+    breakdown are HOST-side clocks, so they cannot fuse into the device
+    telemetry lanes) — the payload is a handful of float64s, noise next to
+    the pass's own counter pull.
+    """
+    arr = np.asarray(values, np.float64).reshape(1, -1)
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+
+    out = np.asarray(multihost_utils.process_allgather(arr))
+    return out.reshape(-1, arr.shape[1])
 
 
 def make_global(host_array: np.ndarray, mesh: Mesh) -> jax.Array:

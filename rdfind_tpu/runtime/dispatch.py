@@ -129,6 +129,39 @@ class DispatchStats:
         metrics.observe("host_pull_ms", dt * 1e3)
         return out
 
+    def overlap_report(self, wall_ms: float, n_passes: int = 0) -> dict:
+        """The overlap-efficiency row of one executor run: measured wall vs
+        the ideal serial/parallel bounds the same pulls imply.
+
+        serial_bound_ms    what the wall would have been with NO overlap —
+                           every overlapped pull re-serialized onto the
+                           critical path (measured + overlap);
+        parallel_bound_ms  the wall with PERFECT overlap — every blocking
+                           pull hidden behind enqueued compute (measured
+                           minus the non-overlapped pull time);
+        overlap_efficiency where the measured wall sits between the two
+                           bounds (1.0 = perfect overlap, 0.0 = fully
+                           serial); equals overlap_ms / pull_ms, since the
+                           bounds differ by exactly pull_ms.
+
+        This is the input the DCN-chunk autotuner needs (ROADMAP item 3):
+        low efficiency with large dcn chunks says "split the hop further",
+        efficiency ~1 says the overlap machinery is already saturated.
+        """
+        pull_ms = self.host_sync_ms
+        overlap_ms = self.pull_overlap_ms
+        serial = wall_ms + overlap_ms
+        parallel = wall_ms - (pull_ms - overlap_ms)
+        eff = overlap_ms / pull_ms if pull_ms > 0 else None
+        return {"n_passes": int(n_passes),
+                "measured_ms": round(wall_ms, 3),
+                "pull_ms": round(pull_ms, 3),
+                "overlap_ms": round(overlap_ms, 3),
+                "serial_bound_ms": round(serial, 3),
+                "parallel_bound_ms": round(parallel, 3),
+                "overlap_efficiency": (round(eff, 4)
+                                       if eff is not None else None)}
+
     def publish(self, stats: dict | None) -> None:
         """Accumulate into a run-level stats dict (multiple pipelines per run:
         the S2L lattice calls run_cooc once per level)."""

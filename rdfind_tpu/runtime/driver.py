@@ -24,7 +24,7 @@ from ..dictionary import Dictionary, intern_triples
 from ..io import native, ntriples, prefixes, reader
 from ..models import allatonce, approximate, late_bb, sharded, small_to_large
 from ..obs import memory as obs_memory
-from ..obs import metrics, report, tracer
+from ..obs import flightrec, metrics, report, tracer
 from ..parallel.mesh import make_mesh
 from . import checkpoint
 
@@ -572,18 +572,23 @@ def _flush_progress_on_signal(enabled: bool):
     """SIGTERM/SIGINT (the preemption notice on TPU VMs) flush every live
     mid-discover ProgressStore before the process dies, so the successor run
     resumes from the last committed pass instead of the last stage boundary.
+    When the flight recorder is armed, the handler also dumps its ring —
+    the post-mortem for runs flying without the jsonl tracer.
 
-    Installed only on the main thread of checkpointed runs; the previous
-    handlers are restored on exit and re-invoked after the flush.
+    Installed only on the main thread, and only when there is work to do
+    (checkpointed runs, or an armed flight recorder); the previous handlers
+    are restored on exit and re-invoked after the flush.
     """
-    if (not enabled
+    if ((not enabled and not flightrec.enabled())
             or threading.current_thread() is not threading.main_thread()):
         yield
         return
     installed = {}
 
     def handler(signum, frame):
-        checkpoint.flush_all_progress()
+        flightrec.dump(reason=f"signal {signum}")
+        if enabled:
+            checkpoint.flush_all_progress()
         signal.signal(signum, installed[signum])
         if signum == signal.SIGINT:
             raise KeyboardInterrupt
@@ -638,6 +643,8 @@ def _obs_session(cfg: Config):
     metrics_file = (cfg.metrics_file
                     or os.environ.get("RDFIND_METRICS_FILE") or None)
     obs_memory.reset()
+    flightrec.configure()  # re-read RDFIND_FLIGHTREC at every run start
+    flightrec.reset()  # one run, one ring (dumps are per-incident anyway)
     if metrics_file:
         metrics.set_export(metrics_file)
     if trace_dir:

@@ -55,7 +55,7 @@ import os
 import random
 import time
 
-from ..obs import metrics, tracer
+from ..obs import flightrec, metrics, tracer
 
 
 class FaultError(RuntimeError):
@@ -68,7 +68,15 @@ class InjectedFault(FaultError):
 
 class Preempted(FaultError):
     """Simulated preemption (the SIGTERM analog): the run must die NOW, and a
-    re-run against the same checkpoint dir must resume, not restart."""
+    re-run against the same checkpoint dir must resume, not restart.
+
+    Raising one dumps the flight recorder (when armed): the preemption IS
+    the post-mortem moment, and the exception may unwind past every other
+    dump site."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        flightrec.dump(reason=f"preempted: {self}")
 
 
 class FallbackRequired(FaultError):
@@ -240,6 +248,10 @@ def record_degradation(stats: dict | None, phase: str, action: str,
     metrics.mapping_set(stats, "ladder_rung", phase, action)
     tracer.instant("degradation", cat=tracer.CAT_DISPATCH, phase=phase,
                    action=action)
+    # Every ladder rung is a post-mortem moment: snapshot the flight
+    # recorder (no-op when unarmed) so the events leading INTO the
+    # degradation survive even if the run later dies without one.
+    flightrec.dump(reason=f"degradation {phase}:{action}")
 
 
 def max_pass_splits(default: int = 2) -> int:

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# One-command verification gate: the ROADMAP tier-1 test recipe followed by
+# a tiny CPU bench whose row feeds the perf-regression sentinel
+# (python -m rdfind_tpu.obs.sentinel --check).
+#
+# Usage:
+#   scripts/verify.sh                  # tests + tiny bench + sentinel gate
+#   VERIFY_SKIP_BENCH=1 scripts/verify.sh   # tests only (fast pre-commit)
+#   BENCH_HISTORY=/path/h.jsonl scripts/verify.sh   # custom history file
+#
+# Exit codes: the tier-1 suite's rc when tests fail; 1 when the tiny bench
+# dies or the sentinel flags a regression; 0 otherwise.  The sentinel
+# compares the newest history row against the trailing rows with the SAME
+# (n_cores, backend, knob-set) key, so a laptop and CI keep separate
+# baselines in one file; the first run on a fresh machine passes by default
+# (no baseline yet).
+
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tier-1 test suite (ROADMAP recipe) =="
+rm -f /tmp/_t1.log
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+    echo "verify: tier-1 suite FAILED (rc=$rc)" >&2
+    exit "$rc"
+fi
+
+if [ "${VERIFY_SKIP_BENCH:-0}" = "1" ]; then
+    echo "verify: tier-1 green; bench + sentinel skipped (VERIFY_SKIP_BENCH=1)"
+    exit 0
+fi
+
+echo "== tiny bench -> BENCH_HISTORY -> regression sentinel =="
+hist="${BENCH_HISTORY:-BENCH_HISTORY.jsonl}"
+if ! BENCH_BACKEND=cpu JAX_PLATFORMS=cpu \
+     BENCH_TRIPLES="${VERIFY_BENCH_TRIPLES:-400}" BENCH_MIN_SUPPORT=2 \
+     BENCH_PIPELINE_TRIPLES=600 BENCH_EXCHANGE_TRIPLES=600 \
+     BENCH_HISTORY="$hist" \
+     timeout -k 10 1800 python bench.py > /tmp/_verify_bench.json; then
+    echo "verify: tiny bench FAILED (see /tmp/_verify_bench.json)" >&2
+    exit 1
+fi
+python -m rdfind_tpu.obs.sentinel --check --history "$hist"

@@ -365,3 +365,57 @@ def test_disabled_tracing_overhead_under_2pct(mesh8):
     assert overhead / wall_s < 0.02, (
         f"disabled obs path costs {overhead * 1e3:.3f}ms over "
         f"{wall_s * 1e3:.0f}ms wall ({overhead / wall_s:.2%})")
+
+
+# ---------------------------------------------------------------------------
+# Histogram quantiles (ISSUE 9): p50/p95/p99 in describe() + exposition.
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_quantiles_describe():
+    h = metrics.Histogram()
+    for v in range(1, 101):
+        h.observe(float(v))
+    d = h.describe()
+    assert d["count"] == 100 and d["min"] == 1.0 and d["max"] == 100.0
+    for k in ("p50", "p95", "p99"):
+        assert k in d
+    # Log-bucketed estimates: ~19% bucket width, so a loose relative bound.
+    assert d["p50"] == pytest.approx(50.0, rel=0.25)
+    assert d["p99"] == pytest.approx(99.0, rel=0.25)
+    assert d["min"] <= d["p50"] <= d["p95"] <= d["p99"] <= d["max"]
+
+
+def test_histogram_quantiles_edge_cases():
+    h = metrics.Histogram()
+    h.observe(0.0)  # non-positive values ride the underflow bucket
+    h.observe(-3.0)
+    h.observe(5.0)
+    d = h.describe()
+    assert d["min"] == -3.0 and d["max"] == 5.0
+    assert d["p50"] >= d["min"] and d["p99"] <= d["max"]
+    one = metrics.Histogram()
+    one.observe(7.0)
+    d1 = one.describe()
+    assert d1["p50"] == d1["p95"] == d1["p99"] == 7.0
+
+
+def test_prometheus_quantile_lines():
+    metrics.observe("demo_latency_ms", 1.0)
+    metrics.observe("demo_latency_ms", 2.0)
+    metrics.observe("demo_latency_ms", 100.0)
+    text = metrics.registry().prometheus_text()
+    assert "# TYPE rdfind_demo_latency_ms summary" in text
+    qlines = [ln for ln in text.splitlines()
+              if ln.startswith('rdfind_demo_latency_ms{quantile=')]
+    assert {f'rdfind_demo_latency_ms{{quantile="{q}"}}'
+            for q in ("0.5", "0.95", "0.99")} \
+        == {ln.rsplit(" ", 1)[0] for ln in qlines}
+    # Every line still satisfies the exposition parse contract.
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert line.startswith("# TYPE rdfind_")
+        else:
+            name, value = line.rsplit(" ", 1)
+            float(value)
+    assert "rdfind_demo_latency_ms_count 3" in text

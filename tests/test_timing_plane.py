@@ -1,0 +1,335 @@
+"""The timing plane (ISSUE 9): collective timers + link probe, straggler
+attribution, overlap metering, the flight recorder, and the perf sentinel.
+
+Everything here runs on the CPU proxy mesh (conftest provides 8 devices);
+on-chip the same code paths time real ICI/DCN hops.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from rdfind_tpu.models import sharded
+from rdfind_tpu.obs import flightrec, metrics, sentinel, tracer
+from rdfind_tpu.parallel import exchange, mesh as mesh_mod
+from rdfind_tpu.parallel.mesh import make_mesh
+from rdfind_tpu.runtime import faults
+from rdfind_tpu.utils.synth import generate_triples
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 CPU devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    """Every test starts disarmed: no tracer, no faults, no flight
+    recorder, no collective timers."""
+    for k in ("RDFIND_FAULTS", "RDFIND_FLIGHTREC", "RDFIND_FLIGHTREC_EVENTS",
+              "RDFIND_COLLECTIVE_TIMING", "RDFIND_LINK_PROBE"):
+        monkeypatch.delenv(k, raising=False)
+    tracer.stop()
+    metrics.reset()
+    faults.reset()
+    flightrec.configure()
+    yield
+    tracer.stop()
+    metrics.reset()
+    faults.reset()
+    flightrec.configure()
+
+
+# ---------------------------------------------------------------------------
+# Collective timers + link probe (tentpole part 1).
+# ---------------------------------------------------------------------------
+
+
+def test_collective_timing_ledger_and_identical_output(mesh8, monkeypatch):
+    triples = generate_triples(300, seed=11, n_predicates=8, n_entities=32)
+    baseline = sharded.discover_sharded(triples, 2, mesh=mesh8)
+
+    monkeypatch.setenv("RDFIND_COLLECTIVE_TIMING", "1")
+    stats: dict = {}
+    timed = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    # Measurement mode must not perturb the discovered CINDs.
+    assert timed.to_rows() == baseline.to_rows()
+    sites = stats["exchange_sites"]
+    for site in ("exchange_a", "exchange_b", "exchange_c", "giant_gather"):
+        e = sites[site]
+        assert e["timed_calls"] >= 1, site
+        assert e["wall_ms"] > 0, site
+        assert e["gbps"] > 0, site
+        assert e["timed_bytes"] > 0, site
+    # Without a link probe there is no measured peak: no utilization claim.
+    assert "link_util" not in sites["exchange_a"]
+    # The registry saw the per-site histograms (Prometheus track).
+    hists = metrics.registry().snapshot().get("histograms", {})
+    assert "exchange_exchange_a_wall_ms" in hists, sorted(hists)
+    assert "exchange_exchange_a_gbps" in hists
+
+
+def test_link_probe_caps_and_utilization(mesh8, monkeypatch):
+    monkeypatch.setenv("RDFIND_LINK_PROBE", "1")
+    caps = mesh_mod.link_probe(mesh8, force=True)
+    assert caps["ici_gbps"] > 0
+    assert caps["num_dev"] == 8
+    assert metrics.link_caps()["ici_gbps"] == caps["ici_gbps"]
+    # Probe cached per topology: a second call is a dict copy, not a bench.
+    t0 = time.perf_counter()
+    again = mesh_mod.link_probe(mesh8)
+    assert again == caps and (time.perf_counter() - t0) < 0.1
+
+    monkeypatch.setenv("RDFIND_COLLECTIVE_TIMING", "1")
+    triples = generate_triples(250, seed=12, n_predicates=8, n_entities=32)
+    stats: dict = {}
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    e = stats["exchange_sites"]["exchange_a"]
+    # With a probed peak every timed site carries a utilization verdict in
+    # (0, 1]-ish territory (>1 would mean the probe under-measured; allow
+    # slack for clock noise but not nonsense).
+    assert 0 < e["link_util"] < 10
+    assert e["ideal_ms"] > 0
+
+
+def test_timing_disabled_path_is_free(mesh8):
+    """Timers off: no timing keys on the ledger, and the gate itself is a
+    single env read bounded like the other disabled obs paths."""
+    triples = generate_triples(200, seed=13, n_predicates=6, n_entities=24)
+    stats: dict = {}
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    for e in stats["exchange_sites"].values():
+        assert "wall_ms" not in e and "gbps" not in e
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        exchange.collective_timing_enabled()
+    per_call_us = (time.perf_counter() - t0) / n * 1e6
+    assert per_call_us < 25.0, f"{per_call_us:.2f}us per gate check"
+
+
+# ---------------------------------------------------------------------------
+# Straggler/skew attribution + overlap metering (tentpole parts 2-3).
+# ---------------------------------------------------------------------------
+
+
+def test_skew_and_overlap_structs(mesh8, monkeypatch):
+    monkeypatch.setenv("RDFIND_COLLECTIVE_TIMING", "1")  # skew consumer
+    monkeypatch.setenv("RDFIND_PAIR_ROW_BUDGET", "4000")  # several passes
+    triples = generate_triples(300, seed=5, n_predicates=8, n_entities=32)
+    stats: dict = {}
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+
+    hs = stats["host_skew"]
+    assert hs["n_hosts"] == 1 and hs["n_passes"] >= 1
+    assert hs["skew_index"] == pytest.approx(1.0)  # one host: no skew
+    assert hs["slowest_host"] == 0
+    assert hs["cause"] in sharded._SkewMeter.PHASES
+    assert len(hs["per_host_ms"]) == 1
+    assert set(hs["phase_ms"]) == set(sharded._SkewMeter.PHASES)
+
+    ov = stats["overlap"]
+    assert ov["n_passes"] == stats["n_pair_passes"]
+    # Bound ordering: parallel <= measured <= serial, and the efficiency is
+    # overlap/pull by construction.
+    assert ov["parallel_bound_ms"] <= ov["measured_ms"] + 1e-6
+    assert ov["measured_ms"] <= ov["serial_bound_ms"] + 1e-6
+    if ov["pull_ms"] > 0:
+        assert ov["overlap_efficiency"] == pytest.approx(
+            ov["overlap_ms"] / ov["pull_ms"], abs=1e-3)
+    # Per-phase histograms landed in the registry.
+    hists = metrics.registry().snapshot().get("histograms", {})
+    assert "pass_compute_ms" in hists
+
+
+def test_skew_meter_inactive_without_consumer(mesh8):
+    triples = generate_triples(200, seed=14, n_predicates=6, n_entities=24)
+    stats: dict = {}
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    assert "host_skew" not in stats  # no consumer -> no per-pass allgathers
+    assert "overlap" in stats        # overlap meter rides existing counters
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder (tentpole part 4).
+# ---------------------------------------------------------------------------
+
+
+def test_flightrec_ring_and_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("RDFIND_FLIGHTREC", str(tmp_path))
+    monkeypatch.setenv("RDFIND_FLIGHTREC_EVENTS", "8")
+    assert flightrec.configure(host_index=3)
+    for i in range(50):  # ring keeps only the configured tail
+        tracer.instant(f"ev{i}", i=i)
+    events = flightrec.snapshot()
+    assert len(events) == 8
+    assert events[-1]["name"] == "ev49"
+    path = flightrec.dump(reason="unit test")
+    assert path == flightrec.dump_path(str(tmp_path), 3)
+    d = flightrec.load(path)
+    assert d["host"] == 3 and d["reason"] == "unit test"
+    assert d["n_events"] == 8
+    assert [e["name"] for e in d["events"]][-1] == "ev49"
+    assert flightrec.find_dumps(str(tmp_path)) == {3: path}
+
+
+def test_flightrec_disabled_by_default():
+    assert not flightrec.enabled()
+    tracer.instant("nobody-home")
+    assert flightrec.snapshot() == []
+    assert flightrec.dump(reason="disarmed") is None
+
+
+def test_flightrec_disabled_span_overhead_micro(tmp_path, monkeypatch):
+    """Armed flight recorder, tracer off: the per-event cost is one module
+    attribute check + a deque append — bound it like the bare disabled path
+    (PR-5 arithmetic-bound shape) so the ring can fly in production."""
+    monkeypatch.setenv("RDFIND_FLIGHTREC", str(tmp_path))
+    flightrec.configure()
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with tracer.span("p", cat=tracer.CAT_PASS):
+            pass
+        tracer.instant("x")
+    per_hit_us = (time.perf_counter() - t0) / (2 * n) * 1e6
+    assert per_hit_us < 25.0, f"{per_hit_us:.2f}us per recorded event"
+    assert len(flightrec.snapshot()) > 0
+
+
+def test_flightrec_dump_on_injected_preemption(tmp_path, mesh8, monkeypatch):
+    """The acceptance path: kill-at-pass fault, jsonl tracer OFF — the
+    post-mortem must still exist and parse."""
+    monkeypatch.setenv("RDFIND_FLIGHTREC", str(tmp_path))
+    monkeypatch.setenv("RDFIND_FAULTS", "preempt@discover:pass=0")
+    faults.reset()
+    flightrec.configure(host_index=0)
+    assert not tracer.enabled()
+    triples = generate_triples(250, seed=15, n_predicates=8, n_entities=32)
+    with pytest.raises(faults.Preempted):
+        sharded.discover_sharded(triples, 2, mesh=mesh8)
+    dumps = flightrec.find_dumps(str(tmp_path))
+    assert 0 in dumps, os.listdir(str(tmp_path))
+    d = flightrec.load(dumps[0])
+    assert "preempt" in d["reason"]
+    assert d["n_events"] > 0
+    names = {e["name"] for e in d["events"]}
+    # The executor's span skeleton fed the ring through the tracer's
+    # disabled path: the post-mortem shows the passes leading into the kill.
+    assert {"pass", "dispatch", "pull-counters"} <= names
+
+
+# ---------------------------------------------------------------------------
+# Perf-regression sentinel (tentpole part 5).
+# ---------------------------------------------------------------------------
+
+
+def _fake_result(wall_s: float, pairs: float) -> dict:
+    return {"value": pairs, "detail": {"wall_s": wall_s}}
+
+
+def test_sentinel_flags_planted_regression(tmp_path):
+    hist = str(tmp_path / "hist.jsonl")
+    for _ in range(4):
+        sentinel.append(_fake_result(1.0, 1000.0), path=hist, backend="cpu")
+    ok, _lines = sentinel.check(path=hist)
+    assert ok  # unchanged re-run passes
+
+    # Planted >= 2x slowdown trips the default 1.5x gate on both the wall
+    # metric (lower-is-better) and the throughput (higher-is-better).
+    sentinel.append(_fake_result(2.2, 450.0), path=hist, backend="cpu")
+    ok, lines = sentinel.check(path=hist)
+    assert not ok
+    text = "\n".join(lines)
+    assert "headline_wall_s" in text and "REGRESSION" in text
+
+    # Recovery row: newest is clean again, the bad row widens the baseline
+    # spread but the verdict is ok.
+    sentinel.append(_fake_result(1.0, 1000.0), path=hist, backend="cpu")
+    ok, _lines = sentinel.check(path=hist)
+    assert ok
+
+
+def test_sentinel_rows_carry_provenance(tmp_path, monkeypatch):
+    monkeypatch.setenv("RDFIND_PAIR_ROW_BUDGET", "12345")
+    hist = str(tmp_path / "hist.jsonl")
+    row = sentinel.append(_fake_result(1.0, 10.0), path=hist, backend="cpu")
+    assert row["n_cores"] == os.cpu_count()
+    assert row["backend"] == "cpu"
+    assert row["knobs"]["RDFIND_PAIR_ROW_BUDGET"] == "12345"
+    (loaded,) = sentinel.load_history(hist)
+    assert loaded["metrics"]["headline_wall_s"] == 1.0
+    # sha is best-effort (None outside a git checkout) but the key exists.
+    assert "sha" in loaded
+
+
+def test_sentinel_different_knobs_never_compare(tmp_path, monkeypatch):
+    hist = str(tmp_path / "hist.jsonl")
+    sentinel.append(_fake_result(1.0, 1000.0), path=hist, backend="cpu")
+    monkeypatch.setenv("RDFIND_PAIR_ROW_BUDGET", "777")
+    sentinel.append(_fake_result(9.9, 10.0), path=hist, backend="cpu")
+    ok, lines = sentinel.check(path=hist)
+    assert ok  # no same-key baseline -> pass by default
+    assert "no baseline" in "\n".join(lines)
+
+
+def test_sentinel_cli(tmp_path):
+    hist = str(tmp_path / "hist.jsonl")
+    src = tmp_path / "bench.json"
+    src.write_text(json.dumps(_fake_result(1.0, 500.0)) + "\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "rdfind_tpu.obs.sentinel",
+         "--append", str(src), "--check", "--history", hist],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    assert "appended" in r.stdout
+    src.write_text(json.dumps(_fake_result(3.0, 150.0)) + "\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "rdfind_tpu.obs.sentinel",
+         "--append", str(src), "--check", "--history", hist],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert r.returncode == 1
+    assert "REGRESSION" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# tpu_watch --json (satellite).
+# ---------------------------------------------------------------------------
+
+
+def test_tpu_watch_status_json(tmp_path):
+    from rdfind_tpu.obs import heartbeat
+
+    d = str(tmp_path)
+    heartbeat.write(d, {"stage": "discover", "pass": 2}, host_index=0)
+    with open(flightrec.dump_path(d, 0), "w") as f:
+        json.dump({"host": 0, "reason": "unit", "dumped_at": 0.0,
+                   "n_events": 1, "events": [{"name": "exchange"}]}, f)
+    time.sleep(1.1)  # age the beat past the stale threshold deterministically
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tpu_watch.py"),
+         "--status", d, "--json", "--stale-s", "1"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1  # stale heartbeat -> wedged
+    out = json.loads(r.stdout)
+    assert out["state"] == "wedged"
+    assert out["hosts"]["0"]["stale"] is True
+    assert out["flightrec"]["0"]["reason"] == "unit"
+    assert out["flightrec"]["0"]["last_events"] == ["exchange"]
+    # Prose mode surfaces the same dump.
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tpu_watch.py"),
+         "--status", d, "--stale-s", "1"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "flight recorder" in r.stdout

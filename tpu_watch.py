@@ -39,7 +39,7 @@ STOP_FILE = os.path.join(REPO, "tpu_watch.stop")
 OBS_DIR = os.path.join(REPO, "tpu_watch_obs")
 
 sys.path.insert(0, REPO)
-from rdfind_tpu.obs import heartbeat  # noqa: E402 (after sys.path fix)
+from rdfind_tpu.obs import flightrec, heartbeat  # noqa: E402 (after sys.path fix)
 
 _STATUS = {"phase": "starting", "attempt": 0}
 
@@ -148,21 +148,66 @@ def run_benches() -> bool:
     return ok
 
 
-def report_status(obs_dir: str, stale_s: float) -> int:
+def _flightrec_summaries(obs_dir: str) -> dict:
+    """Per-host flight-recorder dump summaries found next to the heartbeats
+    (path + reason + event count + the last few event names) — the
+    post-mortem pointer a wedge verdict should hand the operator."""
+    out = {}
+    for host, path in sorted(flightrec.find_dumps(obs_dir).items()):
+        try:
+            d = flightrec.load(path)
+            events = d.get("events", [])
+            out[host] = {
+                "path": path,
+                "reason": d.get("reason"),
+                "dumped_at": d.get("dumped_at"),
+                "n_events": d.get("n_events", len(events)),
+                "last_events": [e.get("name") for e in events[-5:]],
+            }
+        except Exception as e:
+            out[host] = {"path": path,
+                         "error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
     """The wedged-vs-slow verdict over a run's obs directory (exit codes:
     0 alive/done, 1 wedged, 2 no heartbeat at all)."""
     verdict = heartbeat.assess(obs_dir, stale_s=stale_s)
     state = verdict["state"]
+    hosts = {
+        h: {**b, "stale": b["age_s"] > stale_s and not b.get("final")}
+        for h, b in verdict["hosts"].items()}
+    recs = _flightrec_summaries(obs_dir)
+    if as_json:
+        print(json.dumps({"dir": obs_dir, "state": state,
+                          "stale_s": stale_s, "age_s": verdict["age_s"],
+                          "hosts": hosts, "flightrec": recs},
+                         sort_keys=True, default=str))
+        return 2 if state == "missing" else (1 if state == "wedged" else 0)
     if state == "missing":
         print(f"status[{obs_dir}]: no heartbeat files "
               f"(not a traced run directory, or the run never started)")
         return 2
-    for h, b in sorted(verdict["hosts"].items()):
+    for h, b in sorted(hosts.items()):
         where = b.get("stage")
         if b.get("pass") is not None:
             where = f"{where} pass {b.get('pass')}"
+        flags = (" (final)" if b.get("final") else
+                 " (STALE)" if b["stale"] else "")
         print(f"status[{obs_dir}] host {h}: last event {b['age_s']}s ago "
-              f"in {where}" + (" (final)" if b.get("final") else ""))
+              f"in {where}" + flags)
+    # Surface the wedged host's flight recorder when one was dumped: the
+    # ring of events leading into the stall, captured even with the jsonl
+    # tracer off.
+    for h, r in sorted(recs.items()):
+        if "error" in r:
+            print(f"status[{obs_dir}] host {h}: flight recorder at "
+                  f"{r['path']} unreadable ({r['error']})")
+            continue
+        print(f"status[{obs_dir}] host {h}: flight recorder "
+              f"({r['n_events']} events, reason={r['reason']!r}) at "
+              f"{r['path']}; last: {', '.join(map(str, r['last_events']))}")
     print(f"status[{obs_dir}]: {state}" + (
         f" (no span boundary for > {stale_s:.0f}s — wedged, not slow)"
         if state == "wedged" else ""))
@@ -184,9 +229,13 @@ def main() -> int:
                     default=heartbeat.DEFAULT_STALE_S,
                     help="--status: heartbeat age above which a run counts "
                          "as wedged")
+    ap.add_argument("--json", action="store_true",
+                    help="--status: emit one machine-readable JSON line "
+                         "(state + per-host staleness + flight-recorder "
+                         "dump summaries) instead of prose")
     args = ap.parse_args()
     if args.status is not None:
-        return report_status(args.status, args.stale_s)
+        return report_status(args.status, args.stale_s, as_json=args.json)
 
     deadline = time.time() + args.deadline_h * 3600
     attempt = 0
