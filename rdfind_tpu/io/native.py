@@ -6,19 +6,30 @@ path for large dumps.  The pure-Python path (io/reader.py + io/ntriples.py +
 dictionary.intern_triples) remains the reference implementation and the
 fallback when the shared library is absent and cannot be built.
 
-Parallelism: ``RDFIND_INGEST_THREADS`` (default: all cores; ``1`` restores the
-single-threaded serial engine) runs the parse as a work-stealing unit queue —
-whole files, plus byte-range chunks of large plain files split at newline
-boundaries (``RDFIND_INGEST_CHUNK_BYTES``, default 64 MiB; gz members cannot
-be seek-split, so .gz parallelism is at file granularity).  Committed triple
-blocks stream back IN INPUT ORDER while later units still parse
-(:class:`IngestStream`), so the caller's host-side assembly — and any staging
-it feeds, e.g. runtime/multihost_ingest.py's per-host table build — overlaps
-the parse instead of following it.  Ids are bit-identical to the serial path
-by construction: the merge stage hash-partitions the per-thread interners
-with the SAME crc32 partition function as the multi-host dictionary
-(dictionary.value_shard), dedupes shards in parallel, and byte-sort-merges
-them into the global rank order.
+Parallelism: ``RDFIND_INGEST_THREADS`` (default: physical cores, clamped to
+the process affinity mask — hyperthread oversubscription measured 0.62x;
+``1`` restores the single-threaded serial engine) runs the parse as a
+work-stealing unit queue — byte-range chunks of plain files split at newline
+boundaries (``RDFIND_INGEST_CHUNK_BYTES``; unset auto-sizes the grain to
+``input_bytes / (threads * 4)``), exact gzip members of multi-member .gz
+files, and decode→parse pipelined subtasks of large single-member .gz files.
+Committed triple blocks stream back IN INPUT ORDER while later units still
+parse (:class:`IngestStream`), so the caller's host-side assembly — and any
+staging it feeds, e.g. runtime/multihost_ingest.py's per-host table build —
+overlaps the parse instead of following it.  Ids are bit-identical to the
+serial path by construction: the merge stage hash-partitions the per-thread
+interners with the SAME crc32 partition function as the multi-host
+dictionary (dictionary.value_shard), dedupes shards in parallel, and
+byte-sort-merges them into the global rank order.
+
+Speed rungs (each its own env knob, resolved here and pushed into the C
+engine via ``rdf_ingest_set_opts`` so a stale .so fails the bind cleanly):
+``RDFIND_INGEST_SWAR`` (8-byte SWAR delimiter scanning; 0 = scalar oracle),
+``RDFIND_INGEST_MMAP`` (mmap plain files + zero-copy interning; 0 = fread +
+arena copies), ``RDFIND_INGEST_GZ_PIPELINE`` (parallel gzip; 0 = one unit
+per .gz), ``RDFIND_INGEST_GZ_CHUNK_BYTES`` (decoded bytes per pipelined gz
+subtask, default 8 MiB — also the compressed-size floor below which a gz
+stays unpipelined).
 
 Semantics: identical ids/values to the Python path for valid-UTF-8 inputs
 (byte-sort order == np.unique's code-point order).  For invalid UTF-8 the
@@ -37,7 +48,7 @@ import time
 import numpy as np
 
 from ..dictionary import Dictionary
-from ..obs import metrics
+from ..obs import metrics, tracer
 
 _SO_PATH = os.environ.get("RDFIND_NATIVE_SO") or os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "_rdfind_native.so")
@@ -47,33 +58,103 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 _lib = None
 _lib_error: str | None = None
 
-# rdf_ingest_stats lane order (native/rdfind_native.cpp).
+# rdf_ingest_stats2 lane order (native/rdfind_native.cpp); the first 12
+# match the legacy rdf_ingest_stats layout.
 _STAT_FIELDS = ("bytes_read", "read_ms", "parse_ms", "intern_ms", "merge_ms",
                 "remap_ms", "n_threads", "n_units", "queue_stalls",
-                "queue_stall_ms", "n_files", "_reserved")
+                "queue_stall_ms", "n_files", "decode_ms", "mmap_bytes",
+                "n_gz_members", "n_gz_subtasks", "swar", "mmap",
+                "gz_pipeline")
 _N_STATS = len(_STAT_FIELDS)
+_INT_STATS = ("bytes_read", "n_threads", "n_units", "queue_stalls", "n_files",
+              "mmap_bytes", "n_gz_members", "n_gz_subtasks", "swar", "mmap",
+              "gz_pipeline")
 
-DEFAULT_CHUNK_BYTES = 64 << 20
+DEFAULT_GZ_CHUNK_BYTES = 8 << 20
 
 
 class NativeIngestError(RuntimeError):
     pass
 
 
+def physical_cores() -> int:
+    """Physical core count (SMT siblings collapsed), via sysfs topology.
+
+    Hyperthread oversubscription is where the 0.62x parallel-vs-serial row
+    came from: two parse workers sharing one core's load/store ports lose
+    more to interner cache thrash than they gain.  Falls back to
+    os.cpu_count() when the topology files are absent (containers, macOS).
+    """
+    try:
+        seen = set()
+        base = "/sys/devices/system/cpu"
+        for name in os.listdir(base):
+            if not (name.startswith("cpu") and name[3:].isdigit()):
+                continue
+            sib = os.path.join(base, name, "topology", "thread_siblings_list")
+            with open(sib) as f:
+                seen.add(f.read().strip())
+        if seen:
+            return len(seen)
+    except OSError:
+        pass
+    return os.cpu_count() or 1
+
+
 def ingest_threads(threads: int | None = None) -> int:
-    """Resolved worker count: explicit arg > RDFIND_INGEST_THREADS > cores."""
+    """Resolved worker count: explicit arg > RDFIND_INGEST_THREADS > auto.
+
+    Auto clamps to physical cores AND the process affinity mask (cgroup /
+    taskset limits) — whichever is smaller.
+    """
     if threads is None:
         env = os.environ.get("RDFIND_INGEST_THREADS", "")
-        threads = int(env) if env.strip() else (os.cpu_count() or 1)
+        if env.strip():
+            threads = int(env)
+        else:
+            threads = physical_cores()
+            try:
+                threads = min(threads, len(os.sched_getaffinity(0)))
+            except (AttributeError, OSError):
+                pass
     return max(1, int(threads))
 
 
 def ingest_chunk_bytes(chunk_bytes: int | None = None) -> int:
-    """Resolved plain-file split size (gz files never split)."""
+    """Resolved plain-file split size; 0 = auto (native engine sizes the
+    grain to input_bytes / (threads * 4), clamped to [1 MiB, 64 MiB])."""
     if chunk_bytes is None:
         env = os.environ.get("RDFIND_INGEST_CHUNK_BYTES", "")
-        chunk_bytes = int(env) if env.strip() else DEFAULT_CHUNK_BYTES
-    return max(1, int(chunk_bytes))
+        chunk_bytes = int(env) if env.strip() else 0
+    return max(0, int(chunk_bytes))
+
+
+def _env_flag(name: str, default: bool = True) -> bool:
+    v = os.environ.get(name, "").strip().lower()
+    if not v:
+        return default
+    return v not in ("0", "false", "no")
+
+
+def ingest_swar() -> bool:
+    """RDFIND_INGEST_SWAR: 8-byte SWAR delimiter scanning (0 = scalar)."""
+    return _env_flag("RDFIND_INGEST_SWAR")
+
+
+def ingest_mmap() -> bool:
+    """RDFIND_INGEST_MMAP: mmap plain files + zero-copy interning."""
+    return _env_flag("RDFIND_INGEST_MMAP")
+
+
+def ingest_gz_pipeline() -> bool:
+    """RDFIND_INGEST_GZ_PIPELINE: member fan-out + decode→parse pipeline."""
+    return _env_flag("RDFIND_INGEST_GZ_PIPELINE")
+
+
+def ingest_gz_chunk_bytes() -> int:
+    """RDFIND_INGEST_GZ_CHUNK_BYTES: decoded bytes per gz pipeline subtask."""
+    env = os.environ.get("RDFIND_INGEST_GZ_CHUNK_BYTES", "")
+    return max(256, int(env)) if env.strip() else DEFAULT_GZ_CHUNK_BYTES
 
 
 def _build() -> bool:
@@ -122,7 +203,22 @@ def _bind(lib):
     lib.rdf_ingest_thread_remap.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                             ctypes.c_void_p]
     lib.rdf_ingest_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    # PR-10 API: options push + 18-lane stats.  Binding these here means a
+    # stale .so raises AttributeError in load() -> clean Python fallback.
+    lib.rdf_ingest_set_opts.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.c_int, ctypes.c_int64,
+                                        ctypes.c_int]
+    lib.rdf_ingest_stats2.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_int64]
+    lib.rdf_ingest_stats2.restype = ctypes.c_int64
     return lib
+
+
+def _apply_opts(lib, h) -> None:
+    """Push the env-resolved speed-rung knobs into a fresh ingest handle."""
+    lib.rdf_ingest_set_opts(h, int(ingest_swar()), int(ingest_mmap()),
+                            ingest_gz_chunk_bytes(),
+                            int(ingest_gz_pipeline()))
 
 
 def load():
@@ -152,10 +248,9 @@ def available() -> bool:
 
 def _read_stats(lib, h) -> dict:
     buf = (ctypes.c_double * _N_STATS)()
-    lib.rdf_ingest_stats(h, buf)
-    out = {k: float(v) for k, v in zip(_STAT_FIELDS, buf) if k != "_reserved"}
-    for k in ("bytes_read", "n_threads", "n_units", "queue_stalls",
-              "n_files"):
+    lib.rdf_ingest_stats2(h, buf, _N_STATS)
+    out = {k: float(v) for k, v in zip(_STAT_FIELDS, buf)}
+    for k in _INT_STATS:
         out[k] = int(out[k])
     return out
 
@@ -220,6 +315,7 @@ class IngestStream:
             raise NativeIngestError(f"native ingest unavailable: {_lib_error}")
         self._lib = lib
         self._h = lib.rdf_ingest_new()
+        _apply_opts(lib, self._h)
         self.n_threads = ingest_threads(threads)
         encoded = [os.fspath(p).encode() for p in paths]
         arr = (ctypes.c_char_p * max(len(encoded), 1))(*encoded)
@@ -334,20 +430,25 @@ class BlockAssembler:
 def _ingest_parallel(paths, tabs, expect_quad, skip_comments, threads,
                      chunk_bytes, stats):
     t_wall = time.perf_counter()
-    with IngestStream(paths, tabs=tabs, expect_quad=expect_quad,
-                      skip_comments=skip_comments, threads=threads,
-                      chunk_bytes=chunk_bytes) as stream:
-        asm = BlockAssembler()
-        for block, thread_id in stream:
-            asm.add(block, thread_id)
-        remaps = stream.finish()
-        t0 = time.perf_counter()
-        ids = asm.finalize(remaps)
-        remap_ms = (time.perf_counter() - t0) * 1000.0
-        raw, offsets = stream.raw_values()
-        st = stream.stats()
-    values, lossless = _values_from_buffer(raw, offsets)
-    ids, dictionary = canonicalize(ids, values, lossless)
+    with tracer.span("ingest-parallel", cat=tracer.CAT_STAGE,
+                     files=len(paths), threads=ingest_threads(threads)):
+        with IngestStream(paths, tabs=tabs, expect_quad=expect_quad,
+                          skip_comments=skip_comments, threads=threads,
+                          chunk_bytes=chunk_bytes) as stream:
+            asm = BlockAssembler()
+            with tracer.span("ingest-stream", cat=tracer.CAT_STAGE):
+                for block, thread_id in stream:
+                    asm.add(block, thread_id)
+            with tracer.span("ingest-merge", cat=tracer.CAT_STAGE):
+                remaps = stream.finish()
+            with tracer.span("ingest-remap", cat=tracer.CAT_STAGE):
+                t0 = time.perf_counter()
+                ids = asm.finalize(remaps)
+                remap_ms = (time.perf_counter() - t0) * 1000.0
+            raw, offsets = stream.raw_values()
+            st = stream.stats()
+        values, lossless = _values_from_buffer(raw, offsets)
+        ids, dictionary = canonicalize(ids, values, lossless)
     if stats is not None:
         st["remap_ms"] += remap_ms  # host-side block rewrite rides the phase
         publish_stats(stats, st, ids.shape[0], len(dictionary), t_wall)
@@ -358,14 +459,18 @@ def _ingest_serial(paths, tabs, expect_quad, skip_comments, stats):
     lib = load()
     t_wall = time.perf_counter()
     h = lib.rdf_ingest_new()
+    _apply_opts(lib, h)
     try:
         for p in paths:
-            rc = lib.rdf_ingest_file(h, os.fspath(p).encode(), int(tabs),
-                                     int(expect_quad), int(skip_comments))
+            with tracer.span("ingest-file", cat=tracer.CAT_STAGE,
+                             path=os.path.basename(os.fspath(p))):
+                rc = lib.rdf_ingest_file(h, os.fspath(p).encode(), int(tabs),
+                                         int(expect_quad), int(skip_comments))
             if rc < 0:
                 raise NativeIngestError(
                     lib.rdf_ingest_error(h).decode(errors="replace"))
-        n_values = lib.rdf_ingest_finalize(h)
+        with tracer.span("ingest-finalize", cat=tracer.CAT_STAGE):
+            n_values = lib.rdf_ingest_finalize(h)
         n_triples = lib.rdf_ingest_num_triples(h)
         ids = np.empty((n_triples, 3), np.int32)
         if n_triples:
@@ -388,18 +493,24 @@ def _ingest_serial(paths, tabs, expect_quad, skip_comments, stats):
 
 def publish_stats(stats: dict, st: dict, n_triples: int, n_values: int,
                    t_wall: float) -> None:
-    """The sanctioned ingest publish shim: finalize the 12-lane native stats
+    """The sanctioned ingest publish shim: finalize the native stats lanes
     and merge them into the caller's ingest dict via the obs registry
-    mirror (so bytes/s, triples/s etc. also reach Prometheus exposition)."""
+    mirror (so bytes/s, triples/s etc. also reach Prometheus exposition).
+    Per-phase latencies additionally land in registry histograms
+    (``ingest_<phase>_ms``) so tpu_watch --status and the flight recorder
+    can tell a wedged ingest from a slow disk."""
     wall_s = max(time.perf_counter() - t_wall, 1e-9)
     st["wall_ms"] = round(wall_s * 1000.0, 1)
     st["triples"] = int(n_triples)
     st["values"] = int(n_values)
     st["triples_per_sec"] = round(n_triples / wall_s, 1)
     st["bytes_per_sec"] = round(st["bytes_read"] / wall_s, 1)
-    for k in ("read_ms", "parse_ms", "intern_ms", "merge_ms", "remap_ms",
-              "queue_stall_ms"):
-        st[k] = round(st[k], 2)
+    for k in ("read_ms", "decode_ms", "parse_ms", "intern_ms", "merge_ms",
+              "remap_ms", "queue_stall_ms"):
+        if k in st:
+            st[k] = round(st[k], 2)
+            metrics.observe(f"ingest_{k}", st[k])
+    metrics.observe("ingest_wall_ms", st["wall_ms"])
     metrics.mutate(stats, lambda c: c.update(st))
 
 
@@ -419,6 +530,7 @@ def ingest_files(paths, tabs: bool = False, expect_quad: bool = False,
     """
     if load() is None:
         raise NativeIngestError(f"native ingest unavailable: {_lib_error}")
+    paths = list(paths)
     n_threads = ingest_threads(threads)
     if n_threads <= 1:
         return _ingest_serial(paths, tabs, expect_quad, skip_comments, stats)

@@ -58,6 +58,16 @@ METRIC_SPECS = (
      "lower"),
     ("exchange_hier_wall_s", ("detail", "exchange", "hier", "wall_s"),
      "lower"),
+    # Ingest throughput rows (BENCH_INGEST_ONLY=1 runs promote detail.ingest
+    # to the headline; full runs embed the same shape).  Keyed rows carry
+    # n_cores in their provenance, so a 1-core proxy never baselines a
+    # multicore box.
+    ("ingest_serial_triples_per_sec",
+     ("detail", "ingest", "serial", "triples_per_sec"), "higher"),
+    ("ingest_parallel_triples_per_sec",
+     ("detail", "ingest", "parallel", "triples_per_sec"), "higher"),
+    ("ingest_parse_speedup_vs_legacy",
+     ("detail", "ingest", "parse_speedup_vs_legacy"), "higher"),
 )
 _DIRECTIONS = {name: d for name, _, d in METRIC_SPECS}
 

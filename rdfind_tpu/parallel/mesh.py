@@ -69,12 +69,54 @@ def hier_spec(num_dev: int):
 def dcn_chunks() -> int:
     """`RDFIND_HIER_DCN_CHUNKS`: split the inter-host hop of a hierarchical
     exchange into this many independent all_to_all slices of the capacity
-    axis (overlap food for the dispatch-ahead executor).  1 = one collective.
+    axis (overlap food for the dispatch-ahead executor).  1 = one collective;
+    ``auto`` picks from the last measured overlap report (ROADMAP item 3 —
+    see :func:`dcn_chunks_auto`).
     """
+    knob = os.environ.get("RDFIND_HIER_DCN_CHUNKS", "1").strip().lower()
+    if knob == "auto":
+        from ..obs import metrics
+
+        return dcn_chunks_auto(metrics.registry().get("overlap"))
     try:
-        return max(1, int(os.environ.get("RDFIND_HIER_DCN_CHUNKS", "1")))
+        return max(1, int(knob or "1"))
     except ValueError:
         return 1
+
+
+def dcn_chunks_auto(report) -> int:
+    """Chunk count from a measured overlap report (the DispatchStats
+    `overlap_report` dict the executor publishes under the "overlap" key).
+
+    The heuristic reads `overlap_efficiency` — where the measured wall sat
+    between the perfect-overlap and fully-serial bounds on the LAST run:
+
+    * no report yet / no pulls worth hiding (pull_ms < 1 ms) -> 1 — there is
+      nothing for extra chunks to overlap, and each chunk adds a collective's
+      fixed latency;
+    * efficiency >= 0.85 -> 1 — the dispatch-ahead executor is already
+      hiding the pulls; splitting the hop only adds launch overhead;
+    * efficiency >= 0.5 -> 2 — partial overlap: halving the hop gives the
+      executor a second slice to hide behind compute;
+    * below 0.5 -> 4 — the DCN hop dominates the critical path; finer
+      slices are the only overlap food available (4 keeps per-slice payloads
+      well above the latency floor; going finer has measured negative).
+
+    Deliberately one-shot (reads the previous run, steers the next) rather
+    than a controller: exchange walls are noisy at small scale and a stable
+    knob beats a hunting one.
+    """
+    if not isinstance(report, dict):
+        return 1
+    eff = report.get("overlap_efficiency")
+    pull_ms = report.get("pull_ms") or 0.0
+    if eff is None or pull_ms < 1.0:
+        return 1
+    if eff >= 0.85:
+        return 1
+    if eff >= 0.5:
+        return 2
+    return 4
 
 
 def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):

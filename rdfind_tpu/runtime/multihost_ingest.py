@@ -25,7 +25,7 @@ import dataclasses
 import numpy as np
 
 from ..dictionary import Dictionary
-from ..obs import metrics
+from ..obs import metrics, tracer
 from ..io import native, ntriples, reader
 
 
@@ -63,15 +63,17 @@ def _local_ingest(paths, tabs: bool, expect_quad: bool, encoding,
     from ..dictionary import intern_triples
 
     rows = []
-    for _, line in reader.iter_lines(paths, encoding=encoding):
-        t = (ntriples.parse_tab_line(line) if tabs
-             else ntriples.parse_line(line, expect_quad=expect_quad))
-        if t is not None:
-            rows.append(t if transform is None else tuple(
-                transform(v) for v in t))
-    if not rows:
-        return np.zeros((0, 3), np.int32), Dictionary(np.zeros(0, object))
-    out = intern_triples(np.asarray(rows, dtype=object))
+    with tracer.span("ingest-python", cat=tracer.CAT_STAGE, files=len(paths)):
+        for _, line in reader.iter_lines(paths, encoding=encoding):
+            t = (ntriples.parse_tab_line(line) if tabs
+                 else ntriples.parse_line(line, expect_quad=expect_quad))
+            if t is not None:
+                rows.append(t if transform is None else tuple(
+                    transform(v) for v in t))
+        if not rows:
+            return (np.zeros((0, 3), np.int32),
+                    Dictionary(np.zeros(0, object)))
+        out = intern_triples(np.asarray(rows, dtype=object))
     if stats is not None:
         metrics.set_many(stats, n_threads=1, triples=int(out[0].shape[0]),
                          values=len(out[1]), parser="python")
@@ -89,17 +91,22 @@ def _local_ingest_streamed(paths, tabs: bool, expect_quad: bool, stats=None):
     import time
 
     t_wall = time.perf_counter()
-    with native.IngestStream(paths, tabs=tabs,
-                             expect_quad=expect_quad) as stream:
-        asm = native.BlockAssembler()
-        for block, thread_id in stream:
-            asm.add(block, thread_id)  # handoff overlaps the ongoing parse
-        remaps = stream.finish()
-        t0 = time.perf_counter()
-        ids = asm.finalize(remaps)
-        remap_ms = (time.perf_counter() - t0) * 1000.0
-        values, lossless = stream.decoded_values()
-        st = stream.stats()
+    with tracer.span("ingest-parallel", cat=tracer.CAT_STAGE,
+                     files=len(paths), threads=native.ingest_threads()):
+        with native.IngestStream(paths, tabs=tabs,
+                                 expect_quad=expect_quad) as stream:
+            asm = native.BlockAssembler()
+            with tracer.span("ingest-stream", cat=tracer.CAT_STAGE):
+                for block, thread_id in stream:
+                    asm.add(block, thread_id)  # overlaps the ongoing parse
+            with tracer.span("ingest-merge", cat=tracer.CAT_STAGE):
+                remaps = stream.finish()
+            with tracer.span("ingest-remap", cat=tracer.CAT_STAGE):
+                t0 = time.perf_counter()
+                ids = asm.finalize(remaps)
+                remap_ms = (time.perf_counter() - t0) * 1000.0
+            values, lossless = stream.decoded_values()
+            st = stream.stats()
     ids, dictionary = native.canonicalize(ids, values, lossless)
     if stats is not None:
         st["remap_ms"] += remap_ms

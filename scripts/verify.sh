@@ -18,6 +18,21 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 
+echo "== native ingest engine: build from source =="
+# The checked-in .so must never go stale against the grown C API: rebuild
+# from source when a compiler is present (a build FAILURE is fatal — it
+# means rdfind_native.cpp no longer compiles); skip gracefully on
+# compiler-less boxes (the Python fallback path still runs under tier-1,
+# and io/native.py's _bind AttributeErrors a stale .so into that fallback).
+if command -v "${CXX:-g++}" >/dev/null 2>&1; then
+    if ! make -C native; then
+        echo "verify: native build FAILED" >&2
+        exit 1
+    fi
+else
+    echo "verify: no C++ compiler (${CXX:-g++}); native build skipped"
+fi
+
 echo "== tier-1 test suite (ROADMAP recipe) =="
 rm -f /tmp/_t1.log
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

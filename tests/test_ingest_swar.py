@@ -1,0 +1,392 @@
+"""PR-10 ingest speed rungs: SWAR scanning, mmap zero-copy, parallel gzip.
+
+Every rung is a pure speed change — the knob ON and OFF engines must be
+bit-identical (ids, value order, error surface), and both must match the
+pure-Python reference parser.  The scalar path (RDFIND_INGEST_SWAR=0) is the
+byte-exact oracle the SWAR word loop is fuzzed against, including all line
+start alignments 0-7 (the word loop's unaligned-head handling), CRLF,
+missing trailing newlines, and invalid UTF-8.
+"""
+
+import gzip
+import zlib
+
+import numpy as np
+import pytest
+
+from rdfind_tpu.dictionary import intern_triples
+from rdfind_tpu.io import native, ntriples, reader
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def python_path(paths, tabs=False):
+    rows = []
+    for _, line in reader.iter_lines(paths):
+        t = (ntriples.parse_tab_line(line) if tabs
+             else ntriples.parse_line(line))
+        if t is not None:
+            rows.append(t)
+    return intern_triples(np.asarray(rows, dtype=object))
+
+
+def assert_same(got, want):
+    np.testing.assert_array_equal(got[0], want[0])
+    assert list(got[1].values) == list(want[1].values)
+
+
+def ingest_with(monkeypatch, paths, env, **kw):
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    try:
+        return native.ingest_files(paths, **kw)
+    finally:
+        for k in env:
+            monkeypatch.delenv(k)
+
+
+def fuzz_corpus(rng, *, crlf=False, trailing_newline=True, invalid_utf8=False):
+    """One random N-Triples-ish buffer hitting the SWAR scan's branch zoo:
+    IRIs, escaped literals, @lang, ^^<dt>, bare tokens, comments, blanks,
+    and delimiter runs whose positions land on every offset mod 8."""
+    lines = []
+    for _ in range(rng.integers(40, 160)):
+        kind = rng.integers(7)
+        if kind == 0:
+            lines.append("# comment %d" % rng.integers(1000))
+            continue
+        if kind == 1:
+            lines.append("")
+            continue
+        s = "<http://ex/s%d>" % rng.integers(50)
+        p = "<http://ex/p%d>" % rng.integers(7)
+        o_kind = rng.integers(5)
+        if o_kind == 0:
+            o = "<http://ex/o%d>" % rng.integers(40)
+        elif o_kind == 1:
+            # escapes + spaces/tabs inside the quotes: the literal scanner
+            # must not treat them as field delimiters.
+            o = '"v %d \\" \\\\ tail\t x"' % rng.integers(30)
+        elif o_kind == 2:
+            o = '"lang %d"@en-US' % rng.integers(20)
+        elif o_kind == 3:
+            o = '"typed %d"^^<http://ex/dt>' % rng.integers(20)
+        else:
+            o = "_:b%d" % rng.integers(25)
+        sep1 = " " * int(rng.integers(1, 4))
+        sep2 = "\t" if rng.integers(2) else " "
+        lines.append(f"{s}{sep1}{p}{sep2}{o} .")
+    eol = "\r\n" if crlf else "\n"
+    buf = eol.join(lines)
+    if trailing_newline:
+        buf += eol
+    data = buf.encode()
+    if invalid_utf8:
+        # Splice raw invalid bytes into a literal: raw-byte interning must
+        # keep distinct byte strings distinct on both engines.
+        data += b'<s> <p> "\xc3 broken \xa9" .' + (b"\r\n" if crlf else b"\n")
+        data += b'<s> <p> "\xff\xfe" .' + (b"\r\n" if crlf else b"\n")
+    return data
+
+
+@pytest.mark.parametrize("align", range(8))
+def test_swar_vs_scalar_fuzz_alignments(tmp_path, monkeypatch, align):
+    """Differential fuzz at every line-start alignment mod 8: a comment
+    line of `align` bytes (+ newline) shifts every subsequent byte offset,
+    so the SWAR word loop's head/tail handling is exercised at each phase.
+    """
+    rng = np.random.default_rng(100 + align)
+    for round_i in range(4):
+        data = fuzz_corpus(
+            rng, crlf=bool(round_i % 2),
+            trailing_newline=round_i != 2,
+            invalid_utf8=round_i == 3)
+        f = tmp_path / f"fz{align}_{round_i}.nt"
+        prefix = b"#" * align + b"\n" if align else b""
+        f.write_bytes(prefix + data)
+        swar = ingest_with(monkeypatch, [str(f)],
+                           {"RDFIND_INGEST_SWAR": "1"}, threads=1)
+        scalar = ingest_with(monkeypatch, [str(f)],
+                             {"RDFIND_INGEST_SWAR": "0"}, threads=1)
+        assert_same(swar, scalar)
+        if round_i != 3:  # python reference only for valid UTF-8
+            assert_same(swar, python_path([str(f)]))
+
+
+def test_swar_vs_scalar_parallel_and_tabs(tmp_path, monkeypatch):
+    rng = np.random.default_rng(7)
+    nt = tmp_path / "w.nt"
+    nt.write_bytes(fuzz_corpus(rng))
+    tsv = tmp_path / "w.tsv"
+    tsv.write_text("".join(f"s{i % 9}\tp{i % 4}\to val {i % 13}\n"
+                           for i in range(800)))
+    for paths, tabs in (([str(nt)], False), ([str(tsv)], True)):
+        swar = ingest_with(monkeypatch, paths, {"RDFIND_INGEST_SWAR": "1"},
+                           tabs=tabs, threads=4, chunk_bytes=997)
+        scalar = ingest_with(monkeypatch, paths, {"RDFIND_INGEST_SWAR": "0"},
+                             tabs=tabs, threads=4, chunk_bytes=997)
+        assert_same(swar, scalar)
+        assert_same(swar, python_path(paths, tabs=tabs))
+
+
+def test_mmap_parity_mixed_corpus(tmp_path, monkeypatch):
+    """mmap zero-copy vs fread+arena on a corpus with comments, CRLF without
+    trailing newline, tabs-in-literals, and a gz file (which must take the
+    arena path either way) — serial and chunk-split parallel."""
+    a = tmp_path / "a.nt"
+    a.write_text("".join(
+        f"<http://ex/s{i % 31}> <http://ex/p{i % 5}> \"v {i % 17}\" .\n"
+        for i in range(3000)) + "# trailing comment\n")
+    b = tmp_path / "b.nt"
+    b.write_bytes(b"<s> <p> \"tab\tinside\" .\r\n"
+                  b"# crlf comment\r\n"
+                  b"<s> <p> <o> .")  # no trailing newline
+    g = tmp_path / "c.nt.gz"
+    with gzip.open(g, "wt") as f:
+        for i in range(400):
+            f.write(f"<g{i % 11}> <p> \"z {i % 7}\" .\n")
+    paths = [str(a), str(b), str(g)]
+    want = python_path(paths)
+    for threads, chunk in ((1, None), (4, 1 << 12)):
+        mm = ingest_with(monkeypatch, paths, {"RDFIND_INGEST_MMAP": "1"},
+                         threads=threads, chunk_bytes=chunk)
+        rd = ingest_with(monkeypatch, paths, {"RDFIND_INGEST_MMAP": "0"},
+                         threads=threads, chunk_bytes=chunk)
+        assert_same(mm, rd)
+        assert_same(mm, want)
+
+
+def test_mmap_stat_lane_reports_mapping(tmp_path):
+    f = tmp_path / "m.nt"
+    f.write_text("<s> <p> <o> .\n" * 200)
+    stats: dict = {}
+    native.ingest_files([str(f)], threads=1, stats=stats)
+    if native.ingest_mmap():
+        assert stats["mmap_bytes"] >= f.stat().st_size
+    assert stats["swar"] == int(native.ingest_swar())
+    assert stats["mmap"] == int(native.ingest_mmap())
+    assert "decode_ms" in stats
+
+
+def _multi_member_gz(path, n_members, lines_per_member):
+    blob = b""
+    for m in range(n_members):
+        text = "".join(
+            f"<http://ex/m{m}s{i % 19}> <http://ex/p> \"mm {m}.{i % 13}\" .\n"
+            for i in range(lines_per_member))
+        blob += gzip.compress(text.encode())
+    path.write_bytes(blob)
+
+
+def test_multi_member_gz_determinism(tmp_path, monkeypatch):
+    """Concatenated gz members fan out as units; output identical to serial
+    and to the Python reader (which also concatenates members)."""
+    g = tmp_path / "multi.nt.gz"
+    _multi_member_gz(g, n_members=5, lines_per_member=500)
+    stats: dict = {}
+    par = ingest_with(monkeypatch, [str(g)],
+                      {"RDFIND_INGEST_GZ_PIPELINE": "1"},
+                      threads=4, stats=stats)
+    ser = native.ingest_files([str(g)], threads=1)
+    assert_same(par, ser)
+    assert_same(par, python_path([str(g)]))
+    assert stats["n_gz_members"] == 5
+    off = ingest_with(monkeypatch, [str(g)],
+                      {"RDFIND_INGEST_GZ_PIPELINE": "0"}, threads=4)
+    assert_same(off, ser)
+
+
+def test_single_member_gz_pipeline_determinism(tmp_path, monkeypatch):
+    """A single large member cannot be seek-split; the decode→parse pipeline
+    (decoder thread + bounded subtask queue) must still match serial exactly.
+    A tiny RDFIND_INGEST_GZ_CHUNK_BYTES forces many subtasks."""
+    g = tmp_path / "one.nt.gz"
+    with gzip.open(g, "wt") as f:
+        for i in range(6000):
+            f.write(f"<http://ex/s{i % 101}> <http://ex/p{i % 7}> "
+                    f"\"pipe {i % 43}\" .\n")
+    stats: dict = {}
+    par = ingest_with(monkeypatch, [str(g)],
+                      {"RDFIND_INGEST_GZ_PIPELINE": "1",
+                       "RDFIND_INGEST_GZ_CHUNK_BYTES": "4096"},
+                      threads=4, stats=stats)
+    ser = native.ingest_files([str(g)], threads=1)
+    assert_same(par, ser)
+    assert_same(par, python_path([str(g)]))
+    assert stats["n_gz_subtasks"] > 1
+    assert stats["gz_pipeline"] == 1
+
+
+def test_gz_magic_sniff_without_extension(tmp_path):
+    """Gzip content under a plain name routes by magic bytes (gzopen's
+    transparent mode would otherwise diverge between mmap and stream)."""
+    plain_named = tmp_path / "sneaky.nt"
+    plain_named.write_bytes(gzip.compress(
+        b"<s> <p> <o1> .\n<s> <p> <o2> .\n"))
+    got = native.ingest_files([str(plain_named)], threads=1)
+    assert got[0].shape[0] == 2
+    assert_same(got, native.ingest_files([str(plain_named)], threads=4))
+
+
+def test_gz_error_surface_pipelined(tmp_path, monkeypatch):
+    """A corrupt gz fails on the pipelined path like it fails serially —
+    NativeIngestError, not a hang or a partial table."""
+    g = tmp_path / "bad.nt.gz"
+    blob = gzip.compress(
+        b"".join(b"<s%d> <p> <o> .\n" % i for i in range(5000)))
+    g.write_bytes(blob[:len(blob) // 2])  # truncated member
+    with pytest.raises(native.NativeIngestError):
+        native.ingest_files([str(g)], threads=1)
+    with pytest.raises(native.NativeIngestError):
+        ingest_with(monkeypatch, [str(g)],
+                    {"RDFIND_INGEST_GZ_PIPELINE": "1",
+                     "RDFIND_INGEST_GZ_CHUNK_BYTES": "1024"}, threads=4)
+
+
+def test_parse_error_wins_deterministically_under_rungs(tmp_path, monkeypatch):
+    bad = tmp_path / "bad.nt"
+    bad.write_text("<s> <p> <o> .\n" * 30 + "<s> <p>\n")
+    for env in ({"RDFIND_INGEST_SWAR": "0"}, {"RDFIND_INGEST_MMAP": "0"}, {}):
+        with pytest.raises(native.NativeIngestError, match="expected 3 terms"):
+            ingest_with(monkeypatch, [str(bad)], env, threads=4,
+                        chunk_bytes=64)
+
+
+def test_knob_resolvers(monkeypatch):
+    monkeypatch.setenv("RDFIND_INGEST_SWAR", "0")
+    monkeypatch.setenv("RDFIND_INGEST_MMAP", "false")
+    monkeypatch.setenv("RDFIND_INGEST_GZ_PIPELINE", "no")
+    monkeypatch.setenv("RDFIND_INGEST_GZ_CHUNK_BYTES", "17")
+    assert native.ingest_swar() is False
+    assert native.ingest_mmap() is False
+    assert native.ingest_gz_pipeline() is False
+    assert native.ingest_gz_chunk_bytes() == 256  # floor
+    monkeypatch.delenv("RDFIND_INGEST_SWAR")
+    monkeypatch.delenv("RDFIND_INGEST_MMAP")
+    monkeypatch.delenv("RDFIND_INGEST_GZ_PIPELINE")
+    monkeypatch.delenv("RDFIND_INGEST_GZ_CHUNK_BYTES")
+    assert native.ingest_swar() is True
+    assert native.ingest_gz_chunk_bytes() == native.DEFAULT_GZ_CHUNK_BYTES
+    assert native.physical_cores() >= 1
+    # auto threads: physical cores clamped to affinity, never 0.
+    monkeypatch.delenv("RDFIND_INGEST_THREADS", raising=False)
+    assert native.ingest_threads() >= 1
+    assert native.ingest_threads() <= (native.physical_cores())
+    # chunk auto: unset env resolves to 0 (native sizes the grain).
+    monkeypatch.delenv("RDFIND_INGEST_CHUNK_BYTES", raising=False)
+    assert native.ingest_chunk_bytes() == 0
+    assert native.ingest_chunk_bytes(1234) == 1234
+
+
+def test_auto_chunk_grain_splits_large_files(tmp_path):
+    """chunk_bytes=0 (auto) must still split a file larger than the derived
+    grain — here forced by the 1 MiB clamp floor."""
+    f = tmp_path / "big.nt"
+    row = "<http://ex/s%d> <http://ex/p> \"pad %060d\" .\n"
+    with open(f, "w") as fh:
+        for i in range(24_000):
+            fh.write(row % (i % 501, i))
+    assert f.stat().st_size > (1 << 20)
+    stats: dict = {}
+    got = native.ingest_files([str(f)], threads=4, chunk_bytes=0,
+                              stats=stats)
+    assert stats["n_units"] > 1
+    assert_same(got, native.ingest_files([str(f)], threads=1))
+
+
+def test_value_shard_consistency_on_zero_copy_values():
+    """crc32 partitioning over string_view values (zero-copy interner) must
+    still agree with dictionary.value_shard."""
+    from rdfind_tpu.dictionary import value_shard
+
+    for v in ("<http://ex/zc>", '"lit with space"', "_:b9"):
+        for s in (2, 5, 8):
+            assert value_shard(v, s) == zlib.crc32(v.encode()) % s
+
+
+# ---------------------------------------------------------------------------
+# Satellite: DCN-chunk autotune from measured overlap reports.
+# ---------------------------------------------------------------------------
+
+
+def _report(eff, pull_ms=50.0):
+    return {"n_passes": 4, "measured_ms": 100.0, "pull_ms": pull_ms,
+            "overlap_ms": (eff or 0.0) * pull_ms, "serial_bound_ms": 0.0,
+            "parallel_bound_ms": 0.0, "overlap_efficiency": eff}
+
+
+def test_dcn_chunks_auto_heuristic():
+    from rdfind_tpu.parallel import mesh
+
+    assert mesh.dcn_chunks_auto(None) == 1           # no report yet
+    assert mesh.dcn_chunks_auto({}) == 1
+    assert mesh.dcn_chunks_auto(_report(None)) == 1  # no pulls measured
+    assert mesh.dcn_chunks_auto(_report(0.9, pull_ms=0.2)) == 1  # tiny pulls
+    assert mesh.dcn_chunks_auto(_report(0.95)) == 1  # already overlapped
+    assert mesh.dcn_chunks_auto(_report(0.85)) == 1
+    assert mesh.dcn_chunks_auto(_report(0.7)) == 2   # partial overlap
+    assert mesh.dcn_chunks_auto(_report(0.5)) == 2
+    assert mesh.dcn_chunks_auto(_report(0.2)) == 4   # DCN-dominated
+    assert mesh.dcn_chunks_auto(_report(0.0)) == 4
+
+
+def test_dcn_chunks_env_auto_reads_registry(monkeypatch):
+    from rdfind_tpu.obs import metrics
+    from rdfind_tpu.parallel import mesh
+
+    monkeypatch.setenv("RDFIND_HIER_DCN_CHUNKS", "auto")
+    metrics.reset()
+    try:
+        assert mesh.dcn_chunks() == 1  # no overlap row published yet
+        metrics.struct_set(None, "overlap", _report(0.3))
+        assert mesh.dcn_chunks() == 4
+        metrics.struct_set(None, "overlap", _report(0.99))
+        assert mesh.dcn_chunks() == 1
+        monkeypatch.setenv("RDFIND_HIER_DCN_CHUNKS", "3")
+        assert mesh.dcn_chunks() == 3
+        monkeypatch.setenv("RDFIND_HIER_DCN_CHUNKS", "bogus")
+        assert mesh.dcn_chunks() == 1
+    finally:
+        metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: sentinel coverage for ingest rows.
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_extracts_ingest_metrics():
+    from rdfind_tpu.obs import sentinel
+
+    result = {"metric": "ingest_triples_per_sec", "value": 5e5,
+              "detail": {"ingest": {
+                  "n_cores": 4,
+                  "serial": {"triples_per_sec": 4.5e5},
+                  "parallel": {"triples_per_sec": 9.1e5},
+                  "parse_speedup_vs_legacy": 3.4}}}
+    got = sentinel.extract_metrics(result)
+    assert got["ingest_serial_triples_per_sec"] == 4.5e5
+    assert got["ingest_parallel_triples_per_sec"] == 9.1e5
+    assert got["ingest_parse_speedup_vs_legacy"] == 3.4
+
+
+def test_sentinel_gates_ingest_regression(tmp_path):
+    import json
+
+    from rdfind_tpu.obs import sentinel
+
+    hist = tmp_path / "h.jsonl"
+
+    def row(tps):
+        return sentinel.build_row(
+            {"detail": {"ingest": {"parallel": {"triples_per_sec": tps},
+                                   "serial": {"triples_per_sec": tps}}}},
+            backend="cpu")
+
+    with open(hist, "w") as f:
+        for tps in (1e6, 1.02e6, 0.98e6, 4e5):  # last row: 2.5x slower
+            f.write(json.dumps(row(tps)) + "\n")
+    ok, lines = sentinel.check(path=str(hist), threshold=1.5)
+    assert not ok
+    assert any("ingest_parallel_triples_per_sec" in ln for ln in lines)
