@@ -742,6 +742,27 @@ def _run(n: int, min_support: int) -> dict:
     except Exception as e:
         detail["ingest"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # Data-plane snapshot of the headline workload: the log2 join-line and
+    # capture-support distributions (obs/datastats.py), which bench rows
+    # never recorded before — one extra discover with RDFIND_DATASTATS
+    # forced on, so the measured walls above stay on the disabled path.
+    try:
+        ds_stats: dict = {}
+        prev_ds = os.environ.get("RDFIND_DATASTATS")
+        os.environ["RDFIND_DATASTATS"] = "1"
+        try:
+            allatonce.discover(triples, min_support, stats=ds_stats)
+        finally:
+            if prev_ds is None:
+                os.environ.pop("RDFIND_DATASTATS", None)
+            else:
+                os.environ["RDFIND_DATASTATS"] = prev_ds
+        detail["datastats"] = {
+            k: ds_stats[k] for k in ("datastats_lines", "datastats_captures",
+                                     "datastats_block_skip") if k in ds_stats}
+    except Exception as e:
+        detail["datastats"] = {"error": f"{type(e).__name__}: {e}"}
+
     # Unified obs snapshot (ISSUE 5): the metrics-registry mirror of every
     # stats key the process published (dispatch + exchange + ingest + fault
     # telemetry, accumulated across the rows above) plus the current device

@@ -37,7 +37,7 @@ import numpy as np
 
 from .. import conditions as cc
 from ..data import CindTable
-from ..obs import metrics
+from ..obs import datastats, metrics
 from ..ops import cooc, frequency, minimality, pairs, segments
 from ..ops.emission import emit_join_candidates
 
@@ -328,6 +328,19 @@ def prepare_join_lines(triples, min_support, projections,
         metrics.set_many(stats, n_triples=n, n_line_rows=n_rows,
                          n_frequent_rows=n_keep, n_captures=num_caps,
                          total_pairs=0)
+        if datastats.enabled():
+            # line_val_h is (value, capture)-sorted: run lengths ARE the
+            # join-line sizes.
+            lens = np.unique(state["line_val_h"], return_counts=True)[1]
+            datastats.publish_line_stats(
+                stats, hist=datastats.log2_bucket_counts(lens),
+                n_lines=int(lens.size),
+                max_line=int(lens.max()) if lens.size else 0,
+                source="single")
+            datastats.publish_capture_spectrum(
+                stats, hist=datastats.log2_bucket_counts(state["dep_count"]),
+                n_captures=num_caps,
+                max_support=int(state["dep_count"].max()), source="single")
     return state
 
 
@@ -429,6 +442,18 @@ def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
             total_pairs=total_pairs,
             max_line=int(lens_h.max()) if n_lines else 0,
             pair_backend="matmul")
+        if datastats.enabled():
+            datastats.publish_line_stats(
+                stats, hist=datastats.log2_bucket_counts(lens_h),
+                n_lines=int((lens_h > 0).sum()),
+                max_line=int(lens_h.max()) if n_lines else 0,
+                source="single")
+            sup = np.asarray(dep_count_h, np.int64)
+            datastats.publish_capture_spectrum(
+                stats, hist=datastats.log2_bucket_counts(sup),
+                n_captures=num_caps,
+                max_support=int(sup.max()) if sup.size else 0,
+                source="single")
     if dep_id.size == 0:
         return CindTable.empty()
     table = CindTable(
@@ -548,6 +573,18 @@ def discover(triples, min_support: int, projections: str = "spo",
             n_lines=int(line_lens.shape[0]), n_captures=int(num_caps),
             total_pairs=int(pairs_per_line.sum()),
             max_line=int(line_lens.max()) if line_lens.size else 0)
+        if datastats.enabled():
+            datastats.publish_line_stats(
+                stats, hist=datastats.log2_bucket_counts(line_lens),
+                n_lines=int(line_lens.shape[0]),
+                max_line=int(line_lens.max()) if line_lens.size else 0,
+                source="single")
+            sup = np.asarray(dep_count)[:int(num_caps)]
+            datastats.publish_capture_spectrum(
+                stats, hist=datastats.log2_bucket_counts(sup),
+                n_captures=int(num_caps),
+                max_support=int(sup.max()) if sup.size else 0,
+                source="single")
     if int(pairs_per_line.sum()) == 0:
         return CindTable.empty()
 

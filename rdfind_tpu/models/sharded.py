@@ -83,6 +83,7 @@ from .. import conditions as cc
 from ..data import CindTable
 from ..ops import frequency, hashing, minimality, pairs, segments
 from ..ops.emission import emit_join_candidates
+from ..obs import datastats, forecast
 from ..obs import memory as obs_memory
 from ..obs import metrics, tracer
 from ..parallel import exchange
@@ -958,17 +959,28 @@ class _Pipeline:
             self._triples = make_global(padded, mesh)
             self._n_valid = make_global(n_valid, mesh)
 
+        # Data-plane sampling gate (obs/datastats.py): resolved once per
+        # pipeline — the per-pass path pays attribute checks only.  The env
+        # knob must agree across hosts (same contract as RDFIND_TRACE).
+        self._datastats_on = datastats.enabled()
+
         # P1: measured plan for the pre-exchange capacities.  Hierarchical
         # mode also measures the DCN-hop (host-combined) loads exactly.
+        # The raw pre-headroom gathers double as the cap-utilization
+        # numerators (datastats): they ARE the measured demand.
         cap_f, cap_a, cap_fd, cap_ad = _plan_step(
             self._triples, self._n_valid, mesh=mesh, projections=projections,
             use_fis=use_fis, combine=combine, hier=self.hier)
-        self.cap_f = _headroom(host_gather(cap_f)[0]) if use_fis else 1
-        self.cap_a = _headroom(host_gather(cap_a)[0])
+        raw_f = int(host_gather(cap_f)[0]) if use_fis else 0
+        raw_a = int(host_gather(cap_a)[0])
+        self.cap_f = _headroom(raw_f) if use_fis else 1
+        self.cap_a = _headroom(raw_a)
+        raw_fd = raw_ad = 0
         if self.hier is not None:
-            self.cap_f_dcn = (_headroom(host_gather(cap_fd)[0])
-                              if use_fis else 1)
-            self.cap_a_dcn = _headroom(host_gather(cap_ad)[0])
+            raw_fd = int(host_gather(cap_fd)[0]) if use_fis else 0
+            raw_ad = int(host_gather(cap_ad)[0])
+            self.cap_f_dcn = _headroom(raw_fd) if use_fis else 1
+            self.cap_a_dcn = _headroom(raw_ad)
         else:
             self.cap_f_dcn = 0
             self.cap_a_dcn = 0
@@ -1130,6 +1142,50 @@ class _Pipeline:
             metrics.gauge_set(stats, "plane_bits",
                               cooc_ops.resolved_plane_bits())
 
+        # Data plane (obs/datastats.py): the one-shot distribution snapshot
+        # (on-device log2 histograms over the resident lines + capture
+        # table, O(32) host bytes each) and the plan-time cap-utilization
+        # fractions — measured demand vs the headroomed capacities above.
+        # Consumer-gated: without a live consumer this costs two flag checks.
+        if self._datastats_on and stats is not None:
+            used = dict(freq=raw_f, exchange_a=raw_a,
+                        exchange_b=int(plan[0]),
+                        pairs=int(plan[2]) // self.n_pass,
+                        giant_rows=int(plan[3]),
+                        giant_pairs=2 * int(plan[4]) // self.n_pass)
+            if hier_on:
+                used.update(freq_dcn=raw_fd, exchange_a_dcn=raw_ad,
+                            exchange_b_dcn=int(plan[1]))
+            datastats.publish_cap_utilization(stats, self._planned_caps,
+                                              used)
+            self._collect_datastats()
+
+    def _collect_datastats(self):
+        """One device dispatch for the data plane's distribution snapshot:
+        the join-line size histogram and giant-line share over the resident
+        rows, and the capture support spectrum over the capture table."""
+        # "Giant" here is the pair phase's absolute backstop (load >
+        # cap_pairs/4): the skew-relative threshold is per-kernel state, but
+        # the backstop is the bound every configuration shares.
+        prog = _stage_datastats(self.mesh,
+                                giant_load=max(int(self.cap_p) // 4, 1))
+        hist, chist, sc = prog(self.lines[0], self.n_rows, self.tbl[3],
+                               self.n_caps)
+        # Replicated P() outputs: one logical copy single-process, stacked
+        # per-host copies after a multi-process allgather — either way the
+        # first row is the (already psum'd) answer.
+        hist = np.asarray(host_gather(hist)).reshape(-1, 32)[0]
+        chist = np.asarray(host_gather(chist)).reshape(-1, 32)[0]
+        n_lines, max_line, n_giant, n_capt, max_sup = (
+            int(x) for x in np.asarray(host_gather(sc)).reshape(-1, 5)[0])
+        datastats.publish_line_stats(
+            self.stats, hist=datastats.hist_from_bins(hist),
+            n_lines=n_lines, max_line=max_line, giant_lines=n_giant,
+            source="sharded")
+        datastats.publish_capture_spectrum(
+            self.stats, hist=datastats.hist_from_bins(chist),
+            n_captures=n_capt, max_support=max_sup, source="sharded")
+
     def _maybe_rebalance(self):
         """Greedy least-loaded reassignment of hot lines (the reference's
         LoadBasedPartitioner semantics over measured loads)."""
@@ -1227,13 +1283,17 @@ class _Pipeline:
         self.lines = cols
         self.n_rows = n_rows
 
-    def _count_overflow_retry(self, phase: str, site: str | None = None) -> None:
-        """Ledger + telemetry for one capacity-grow retry (ladder rung 0)."""
+    def _count_overflow_retry(self, phase: str, site: str | None = None,
+                              pass_idx: int | None = None) -> None:
+        """Ledger + telemetry for one capacity-grow retry (ladder rung 0).
+        `pass_idx` stamps pass-loop rungs so the forecast differential can
+        order advisories against the rung that confirmed them."""
         if self.stats is not None:
             metrics.counter_add(self.stats, "n_overflow_retries")
             if site is not None:
                 exchange.log_exchange_retry(self.stats, site)
-        faults.record_degradation(self.stats, phase, "grow")
+        detail = {} if pass_idx is None else {"pass": int(pass_idx)}
+        faults.record_degradation(self.stats, phase, "grow", **detail)
 
     def _overflow_exhausted(self, phase: str, detail: str):
         """Grow retries exhausted with no further rung for this phase: strict
@@ -1412,6 +1472,11 @@ class _Pipeline:
         d = dispatch.DispatchStats(pull_base=self._pull_base)
         t_attempt = time.perf_counter()
         meter = _SkewMeter(self.stats, what)
+        # Cap-exhaustion forecaster (obs/forecast.py): fed each committed
+        # pass's utilization fractions, it names the cap and predicted pass
+        # BEFORE the grow/split rungs fire.  Resolved once per attempt.
+        fc = (forecast.Forecaster(self.stats, self.n_pass, phase=what)
+              if self.stats is not None and forecast.enabled() else None)
         # Phase clock: zero-cost no-op unless a skew consumer is live.
         now = time.perf_counter if meter.active else (lambda: 0.0)
         parts = [None] * self.n_pass
@@ -1508,7 +1573,8 @@ class _Pipeline:
                             f"{what} overflow persisted after "
                             f"{self.max_retries} retries "
                             f"({np.asarray(ovf).tolist()})")
-                    self._count_overflow_retry(what, site="exchange_c")
+                    self._count_overflow_retry(what, site="exchange_c",
+                                               pass_idx=p)
                     inflight.clear()  # discard optimistic successors
                     self._grow_pair_caps(ovf)
                     d.n_cap_retries += 1
@@ -1519,6 +1585,23 @@ class _Pipeline:
                     lambda: self.collect_blocks(cols, n_out),
                     overlapped=bool(inflight), what="pull-blocks")
                 teles[p] = tuple(int(x) for x in tele_h[_N_OVF:])
+                if self._datastats_on or fc is not None:
+                    # Per-pass cap-utilization trajectory from the tail
+                    # telemetry lanes (already pulled — zero extra host
+                    # traffic).  The lanes are global psum totals, so the
+                    # fractions are average-per-device estimates; skew puts
+                    # the max higher, which the overflow ladder owns.
+                    ngl_p, ngp_p, npt_p = teles[p]
+                    fr = {"pairs": ((npt_p - ngp_p)
+                                    / max(self.num_dev * self.cap_p, 1)),
+                          "giant_pairs": (ngp_p
+                                          / max(self.num_dev * self.cap_gp,
+                                                1))}
+                    metrics.gauge_set(None, "run_pass", p)
+                    if self._datastats_on:
+                        datastats.publish_pass_utilization(self.stats, p, fr)
+                    if fc is not None:
+                        fc.step(p, fr)
                 t_commit = now()
                 if tracer.enabled() or metrics.export_requested():
                     # Per-pass HBM watermark + allocation delta (near-cap
@@ -2182,6 +2265,51 @@ def _stage_count_fcs(mesh, capacity: int, include_binary: bool):
 
 
 @functools.lru_cache(maxsize=None)
+def _stage_datastats(mesh, giant_load: int):
+    """Compiled shard_map program: the data plane's one-shot distribution
+    snapshot over the pipeline's resident state (obs/datastats.py).
+
+    Returns three tiny replicated arrays — the 32-bin log2 join-line size
+    histogram, the 32-bin capture support spectrum, and a packed scalar lane
+    [n_lines, max_line, n_giant_lines, n_captures, max_support] — so the
+    host pull is O(32) ints however large the resident rows are.  Giant =
+    quadratic load over the pair phase's absolute backstop (`giant_load`)."""
+    def f(jv, n_rows, tcnt, n_caps):
+        r = jv.shape[0]
+        valid = jnp.arange(r, dtype=jnp.int32) < n_rows[0]
+        # Rebalancing may interleave value buckets; a local sort restores
+        # the contiguous-run invariant the run helpers need.
+        jv_s = jnp.sort(jnp.where(valid, jv, SENTINEL))
+        sizes = segments.masked_row_counts([jv_s], valid)
+        line = segments.run_starts([jv_s]) & valid & (sizes > 0)
+        exp = jnp.clip(31 - jax.lax.clz(jnp.maximum(sizes, 1)), 0, 31)
+        hist = jax.lax.psum(
+            jnp.zeros(32, jnp.int32).at[exp].add(line.astype(jnp.int32)),
+            AXIS)
+        load = sizes.astype(jnp.float32) * (sizes - 1).astype(jnp.float32)
+        n_giant = jax.lax.psum(
+            jnp.sum((line & (load > float(giant_load))).astype(jnp.int32)),
+            AXIS)
+        n_lines = jax.lax.psum(jnp.sum(line.astype(jnp.int32)), AXIS)
+        max_line = jax.lax.pmax(jnp.max(jnp.where(line, sizes, 0)), AXIS)
+
+        c = tcnt.shape[0]
+        cvalid = (jnp.arange(c, dtype=jnp.int32) < n_caps[0]) & (tcnt > 0)
+        cexp = jnp.clip(31 - jax.lax.clz(jnp.maximum(tcnt, 1)), 0, 31)
+        chist = jax.lax.psum(
+            jnp.zeros(32, jnp.int32).at[cexp].add(cvalid.astype(jnp.int32)),
+            AXIS)
+        n_capt = jax.lax.psum(jnp.sum(cvalid.astype(jnp.int32)), AXIS)
+        max_sup = jax.lax.pmax(jnp.max(jnp.where(cvalid, tcnt, 0)), AXIS)
+        sc = exchange.pack_counters([n_lines, max_line, n_giant, n_capt,
+                                     max_sup])
+        return hist, chist, sc
+
+    return jax.jit(shard_map(
+        f, mesh=mesh, in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(), P(), P())))
+
+
 def _stage_join_histogram(mesh, capacity: int, projections: str):
     """Compiled shard_map program: per-line distinct-capture counts over a
     preshard (the distributed --create-join-histogram pass,

@@ -46,7 +46,7 @@ import numpy as np
 
 from .. import conditions as cc
 from ..data import NO_VALUE, CindTable
-from ..obs import metrics
+from ..obs import datastats, metrics
 from ..ops import cooc as cooc_ops
 from ..ops import frequency, minimality, pairs, segments, sketch
 from ..runtime import dispatch, faults
@@ -449,6 +449,18 @@ def _prepare_dense(padded, n, min_support, projections, use_fc_filter, use_ars,
             pair_backend="matmul",
             dense_plan=plan.describe(), cooc_dtype=plan.dtype,
             plane_bits=plan.plane_bits)
+        if datastats.enabled():
+            datastats.publish_line_stats(
+                stats, hist=datastats.log2_bucket_counts(lens64),
+                n_lines=int((lens64 > 0).sum()),
+                max_line=int(lens64.max()) if lens64.size else 0,
+                source="single")
+            sup = dep_count.astype(np.int64)
+            datastats.publish_capture_spectrum(
+                stats, hist=datastats.log2_bucket_counts(sup),
+                n_captures=num_caps,
+                max_support=int(sup.max()) if sup.size else 0,
+                source="single")
     fn = _DenseCooc(m, cooc_m, dep_count_d, c_pad, n_lines, num_caps)
     return (fn, cap_code.astype(np.int64), cap_v1.astype(np.int64),
             cap_v2.astype(np.int64), dep_count.astype(np.int64), num_caps)

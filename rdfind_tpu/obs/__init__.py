@@ -35,15 +35,16 @@ runtime/faults.py can depend on obs without widening their import footprint.
 
 from __future__ import annotations
 
-from . import (flightrec, heartbeat, memory, metrics,  # noqa: F401
-               report, sentinel, tracer)
+from . import (console, datastats, flightrec, forecast,  # noqa: F401
+               heartbeat, memory, metrics, report, sentinel, tracer)
 
 
 def active() -> bool:
-    """Whether any obs output is live (tracing or metrics exposition) —
-    the gate for sampling work that is pure overhead without a consumer
-    (e.g. per-pass HBM watermark reads)."""
-    return tracer.enabled() or metrics.export_requested()
+    """Whether any obs output is live (tracing, metrics exposition, or the
+    run console) — the gate for sampling work that is pure overhead without
+    a consumer (e.g. per-pass HBM watermark reads)."""
+    return (tracer.enabled() or metrics.export_requested()
+            or console.serving())
 
 
 def snapshot() -> dict:

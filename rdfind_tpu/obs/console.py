@@ -1,0 +1,186 @@
+"""The live run console: an off-by-default per-host stdlib HTTP server.
+
+Until now the only ways to watch a running job were tail-ing heartbeat
+files over a shared filesystem or waiting for the Prometheus exposition
+file to flush.  The console serves the same state over HTTP while the run
+is alive, one server per host (``RDFIND_CONSOLE_PORT`` or
+``--console-port``; port 0 binds an ephemeral port, printed at startup):
+
+  /metrics    the exact Prometheus text ``--metrics-file`` would write
+  /status     liveness: this host's serving state + heartbeat.assess()
+              over the obs directory when one is armed
+  /progress   where the run is: current stage/pass, per-cap utilization
+              (plan-time + per-pass trajectory), forecast advisories, and
+              host skew.  The skew/cap structs are already allgathered by
+              the sharded executor before they reach the registry, so the
+              primary host's /progress IS the aggregated multi-host view.
+  /datastats  the data plane: join-line histograms, capture spectra,
+              block-skip effectiveness (obs/datastats.py's structs)
+  /flightrec  the crash-surviving ring (obs/flightrec.py), newest last
+
+Everything is read-only and served from in-process state (the registry,
+the flight recorder, the heartbeat directory) — the handler threads never
+touch device state, so a scrape cannot perturb the run.  The server binds
+loopback by default; it is a debugging surface, not a product API.
+
+Stdlib-only (the obs contract): http.server's ThreadingHTTPServer on a
+daemon thread.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import flightrec, heartbeat, metrics
+
+DEFAULT_HOST = "127.0.0.1"
+
+# /progress picks these registry keys (when present) — the "where is the
+# run and how much headroom is left" slice of the full snapshot.
+_PROGRESS_KEYS = ("run_stage", "run_pass", "n_pair_passes", "planned_caps",
+                  "cap_utilization", "cap_utilization_passes",
+                  "cap_forecast", "cap_forecast_active", "host_skew",
+                  "degradations", "ladder_rung")
+
+_SERVER: ThreadingHTTPServer | None = None
+_THREAD: threading.Thread | None = None
+_OBS_DIR: str | None = None
+
+
+def env_port() -> int | None:
+    """RDFIND_CONSOLE_PORT, or None when unset/blank/non-numeric."""
+    v = os.environ.get("RDFIND_CONSOLE_PORT", "").strip()
+    if not v:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+def serving() -> bool:
+    return _SERVER is not None
+
+
+def port() -> int | None:
+    """The bound port (resolves port-0 ephemeral binds), or None."""
+    return _SERVER.server_address[1] if _SERVER is not None else None
+
+
+def set_obs_dir(directory: str | None) -> None:
+    """Point /status at the run's heartbeat directory (driver wires this
+    when tracing and the console are both armed)."""
+    global _OBS_DIR
+    _OBS_DIR = directory
+
+
+def start(bind_port: int = 0, host: str = DEFAULT_HOST,
+          obs_dir: str | None = None) -> int | None:
+    """Start the console (idempotent); returns the bound port, or None when
+    the bind fails — a console that cannot bind must never fail the run."""
+    global _SERVER, _THREAD
+    if _SERVER is not None:
+        return _SERVER.server_address[1]
+    try:
+        server = ThreadingHTTPServer((host, int(bind_port)), _Handler)
+    except OSError:
+        return None
+    server.daemon_threads = True
+    if obs_dir is not None:
+        set_obs_dir(obs_dir)
+    _SERVER = server
+    _THREAD = threading.Thread(target=server.serve_forever,
+                               name="rdfind-console", daemon=True)
+    _THREAD.start()
+    return server.server_address[1]
+
+
+def stop() -> None:
+    global _SERVER, _THREAD
+    server, _SERVER = _SERVER, None
+    if server is None:
+        return
+    try:
+        server.shutdown()
+        server.server_close()
+    except Exception:
+        pass
+    if _THREAD is not None:
+        _THREAD.join(timeout=5.0)
+        _THREAD = None
+    set_obs_dir(None)
+
+
+# ---------------------------------------------------------------------------
+# Endpoint payload builders (module functions so tests can call them without
+# a socket).
+# ---------------------------------------------------------------------------
+
+
+def progress_payload() -> dict:
+    snap = metrics.registry().snapshot(jsonable=True)
+    out = {k: snap[k] for k in _PROGRESS_KEYS if k in snap}
+    out.setdefault("run_stage", None)
+    out.setdefault("run_pass", None)
+    return out
+
+
+def datastats_payload() -> dict:
+    snap = metrics.registry().snapshot(jsonable=True)
+    return {k: v for k, v in sorted(snap.items())
+            if k.startswith("datastats_")}
+
+
+def status_payload() -> dict:
+    out = {"serving": True, "pid": os.getpid(), "obs_dir": _OBS_DIR}
+    if _OBS_DIR:
+        out["heartbeat"] = heartbeat.assess(_OBS_DIR)
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # A scrape must never spam the run's stderr.
+    def log_message(self, fmt, *args):  # noqa: D102 (http.server API)
+        pass
+
+    def _send(self, body: str, content_type: str, code: int = 200) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        try:
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # the scraper hung up; the run does not care
+
+    def _send_json(self, payload, code: int = 200) -> None:
+        self._send(json.dumps(payload, indent=1, default=str) + "\n",
+                   "application/json", code)
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(metrics.registry().prometheus_text(),
+                           "text/plain; version=0.0.4")
+            elif path == "/status":
+                self._send_json(status_payload())
+            elif path == "/progress":
+                self._send_json(progress_payload())
+            elif path == "/datastats":
+                self._send_json(datastats_payload())
+            elif path == "/flightrec":
+                self._send_json({"enabled": flightrec.enabled(),
+                                 "events": flightrec.snapshot()})
+            elif path == "/":
+                self._send_json({"endpoints": ["/metrics", "/status",
+                                               "/progress", "/datastats",
+                                               "/flightrec"]})
+            else:
+                self._send_json({"error": f"unknown path {path}"}, code=404)
+        except Exception as e:  # a bad scrape must never kill the thread
+            self._send_json({"error": repr(e)}, code=500)

@@ -98,8 +98,13 @@ def assess(directory: str, stale_s: float = DEFAULT_STALE_S,
 
     Returns {"state": "missing"|"done"|"alive"|"wedged", "age_s", "hosts"}:
     `alive` = every heartbeat is fresh (the run may be slow, but spans are
-    still closing); `wedged` = at least one host's last event is older than
-    `stale_s`; `done` = every host wrote its final beat.
+    still closing); `wedged` = at least one NON-final host's last event is
+    older than `stale_s` — a host that wrote its final beat is finished, not
+    stuck, however old that beat is (an all-final set is "done", so a
+    final-but-old host must never flip a still-working peer's run to
+    "wedged"); `done` = every host wrote its final beat.  Hosts whose
+    clocks run ahead of the assessor's produce negative ages, which are
+    trivially fresh.
     """
     beats = read_all(directory)
     if not beats:
@@ -108,7 +113,8 @@ def assess(directory: str, stale_s: float = DEFAULT_STALE_S,
     ages = {h: round(now - b.get("ts", 0.0), 1) for h, b in beats.items()}
     if all(b.get("final") for b in beats.values()):
         state = "done"
-    elif any(age > stale_s for age in ages.values()):
+    elif any(age > stale_s for h, age in ages.items()
+             if not beats[h].get("final")):
         state = "wedged"
     else:
         state = "alive"

@@ -25,7 +25,7 @@ from __future__ import annotations
 import json
 import os
 
-from . import metrics
+from . import forecast, metrics
 from .tracer import EVENTS_PREFIX
 
 TRACE_FILE = "trace.json"
@@ -262,6 +262,103 @@ def format_debug_lines(stats: dict) -> list[str]:
             f"host_pull_retries={stats.get('n_host_pull_retries', 0)} "
             f"backoff_ms={stats.get('backoff_ms_total', 0.0):.1f} "
             f"resumed_passes={stats.get('resumed_passes', 0)}")
+    if stats.get("datastats_lines"):
+        # The data plane: what the join-line / capture distributions looked
+        # like (obs/datastats.py), not just what the machinery did to them.
+        dl = stats["datastats_lines"]
+        lines.append(
+            f"datastats[lines]: n={dl.get('n_lines')} "
+            f"max={dl.get('max_line')} giants={dl.get('giant_lines')} "
+            f"giant_share={dl.get('giant_share')} "
+            f"source={dl.get('source')}")
+    if stats.get("datastats_captures"):
+        dc = stats["datastats_captures"]
+        lines.append(
+            f"datastats[captures]: n={dc.get('n_captures')} "
+            f"max_support={dc.get('max_support')} "
+            f"source={dc.get('source')}")
+    if stats.get("datastats_block_skip"):
+        bs = stats["datastats_block_skip"]
+        lines.append(
+            f"datastats[block_skip]: skipped={bs.get('n_blocks_skipped')}"
+            f"/{bs.get('n_blocks')} frac={bs.get('skip_frac')}")
+    if stats.get("cap_utilization"):
+        caps = " ".join(
+            f"{cap}={row.get('used')}/{row.get('planned')}"
+            f"({row.get('frac')})"
+            for cap, row in sorted(stats["cap_utilization"].items()))
+        lines.append(f"cap utilization: {caps}")
+    # Forecast advisories render through the one shared formatter — the
+    # --debug output and `report --summary` cannot drift apart.
+    lines.extend(forecast.format_lines(stats))
+    return lines
+
+
+def summarize_passes(trace_dir: str) -> dict[int, dict]:
+    """Per-host per-pass rows joined from the trace counter lanes.
+
+    Reads each host's ``events-host<N>.jsonl`` and rebuilds the pass table
+    the run printed live: one row per ``pass_phase_ms`` counter sample, the
+    preceding ``host_skew`` sample attached to it (the skew meter emits
+    skew, then phases, per committed pass), the matching
+    ``cap_utilization`` sample joined on its own pass index, and every
+    ``cap_forecast`` instant collected as advisories.  Returns
+    {host: {"passes": [row...], "advisories": [adv...]}}.
+    """
+    out: dict[int, dict] = {}
+    for h, path in sorted(host_event_files(trace_dir).items()):
+        rows: list[dict] = []
+        util_by_pass: dict[int, dict] = {}
+        advisories: list[dict] = []
+        pending_skew: dict | None = None
+        for ev in load_events(path):
+            name, ph = ev.get("name"), ev.get("ph")
+            args = ev.get("args", {})
+            if ph == "C" and name == "host_skew":
+                pending_skew = args
+            elif ph == "C" and name == "pass_phase_ms":
+                row = {"pass": len(rows), "phase_ms": dict(args)}
+                if pending_skew is not None:
+                    row["skew"] = pending_skew.get("skew")
+                    row["slowest"] = pending_skew.get("slowest")
+                    pending_skew = None
+                rows.append(row)
+            elif ph == "C" and name == "cap_utilization":
+                util_by_pass[args.get("pass")] = {
+                    k: v for k, v in args.items() if k != "pass"}
+            elif ph == "i" and name == "cap_forecast":
+                advisories.append(dict(args))
+        for row in rows:
+            if row["pass"] in util_by_pass:
+                row["cap_util"] = util_by_pass[row["pass"]]
+        out[h] = {"passes": rows, "advisories": advisories}
+    return out
+
+
+def format_summary_lines(summary: dict[int, dict]) -> list[str]:
+    """The `report --summary` rendering: one line per committed pass (total
+    + phase split + host skew + cap-utilization fractions), then the
+    forecast advisories through the shared advisory formatter."""
+    lines: list[str] = []
+    for h in sorted(summary):
+        for row in summary[h]["passes"]:
+            pm = row["phase_ms"]
+            total = sum(v for v in pm.values()
+                        if isinstance(v, (int, float)))
+            phases = " ".join(f"{k}={v}" for k, v in pm.items())
+            skew = (f" skew={row['skew']} slowest={row['slowest']}"
+                    if row.get("skew") is not None else "")
+            util = ""
+            if row.get("cap_util"):
+                util = " | util " + " ".join(
+                    f"{k}={v}" for k, v in sorted(row["cap_util"].items()))
+            lines.append(f"host {h} pass {row['pass']}: {total:.1f} ms "
+                         f"({phases}){skew}{util}")
+        for adv in summary[h]["advisories"]:
+            lines.append(f"host {h} " + forecast.advisory_line(adv))
+    if not lines:
+        lines.append("no committed passes recorded (was the run traced "
+                     "with --trace, and did it reach the pair passes?)")
     return lines
 
 
@@ -303,11 +400,19 @@ def main(argv=None) -> int:
     ap.add_argument("trace_dir", help="directory holding events-host*.jsonl")
     ap.add_argument("-o", "--output", default=None,
                     help="output path (default: TRACE_DIR/trace.json)")
+    ap.add_argument("--summary", action="store_true",
+                    help="print the per-pass table (phase ms, host skew, "
+                         "cap utilization, forecast advisories) instead of "
+                         "exporting the Chrome trace")
     args = ap.parse_args(argv)
     files = host_event_files(args.trace_dir)
     if not files:
         print(f"no {EVENTS_PREFIX}*.jsonl files in {args.trace_dir}")
         return 1
+    if args.summary:
+        for line in format_summary_lines(summarize_passes(args.trace_dir)):
+            print(line)
+        return 0
     out = export_chrome_trace(args.trace_dir, args.output)
     n = sum(len(load_events(p)) for p in files.values())
     print(f"wrote {out} ({len(files)} host lane(s), {n} events)")

@@ -164,28 +164,37 @@ def _row_key(row: dict) -> str:
                        row.get("knobs", {})], sort_keys=True, default=str)
 
 
-def check(path: str | None = None, threshold: float | None = None,
-          window: int | None = None) -> tuple[bool, list[str]]:
-    """(ok, report_lines): newest row vs the trailing same-key baseline."""
+def check_verdict(path: str | None = None, threshold: float | None = None,
+                  window: int | None = None) -> dict:
+    """The structured verdict behind :func:`check` (and ``--json``).
+
+    Returns {"ok", "status", "threshold", "window", "n_baseline", "sha",
+    "metrics": {name: {value, median, worse_ratio, gate, regressed}},
+    "regressions": [name...]}, where status is one of "no-history" /
+    "no-baseline" / "ok" / "regression".
+    """
     if threshold is None:
         threshold = float(os.environ.get("RDFIND_SENTINEL_THRESHOLD",
                                          str(DEFAULT_THRESHOLD)))
     if window is None:
         window = int(os.environ.get("RDFIND_SENTINEL_WINDOW",
                                     str(DEFAULT_WINDOW)))
+    verdict = {"ok": True, "status": "no-history", "threshold": threshold,
+               "window": window, "n_baseline": 0, "sha": None,
+               "metrics": {}, "regressions": []}
     rows = load_history(path)
     if not rows:
-        return True, ["sentinel: no history rows — nothing to check"]
+        return verdict
     newest = rows[-1]
+    verdict["sha"] = newest.get("sha")
+    verdict["n_cores"] = newest.get("n_cores")
+    verdict["backend"] = newest.get("backend")
     key = _row_key(newest)
     baseline = [r for r in rows[:-1] if _row_key(r) == key][-window:]
     if not baseline:
-        return True, [f"sentinel: no baseline rows match "
-                      f"(n_cores={newest.get('n_cores')}, "
-                      f"backend={newest.get('backend')}) — pass by default"]
-    lines = [f"sentinel: newest sha={newest.get('sha')} vs "
-             f"{len(baseline)} baseline row(s), threshold {threshold}x"]
-    regressions = []
+        verdict["status"] = "no-baseline"
+        return verdict
+    verdict["n_baseline"] = len(baseline)
     for name, value in sorted(newest.get("metrics", {}).items()):
         hist = [r["metrics"][name] for r in baseline
                 if isinstance(r.get("metrics", {}).get(name), (int, float))]
@@ -206,13 +215,40 @@ def check(path: str | None = None, threshold: float | None = None,
         # margin) widens the threshold — a jittery metric needs a bigger
         # excursion to page than a historically stable one.
         gate = max(threshold, spread * 1.1)
-        verdict = "REGRESSION" if worse > gate else "ok"
-        lines.append(f"  {name}: {value} vs median {median} "
-                     f"(worse-ratio {worse:.3f}, gate {gate:.3f}) {verdict}")
-        if worse > gate:
-            regressions.append(name)
-    if regressions:
-        lines.append(f"sentinel: REGRESSION in {', '.join(regressions)}")
+        regressed = worse > gate
+        verdict["metrics"][name] = {
+            "value": value, "median": median,
+            "worse_ratio": round(worse, 3), "gate": round(gate, 3),
+            "regressed": regressed}
+        if regressed:
+            verdict["regressions"].append(name)
+    verdict["ok"] = not verdict["regressions"]
+    verdict["status"] = "ok" if verdict["ok"] else "regression"
+    return verdict
+
+
+def check(path: str | None = None, threshold: float | None = None,
+          window: int | None = None) -> tuple[bool, list[str]]:
+    """(ok, report_lines): newest row vs the trailing same-key baseline —
+    the prose rendering of :func:`check_verdict` (exit semantics
+    unchanged)."""
+    v = check_verdict(path=path, threshold=threshold, window=window)
+    if v["status"] == "no-history":
+        return True, ["sentinel: no history rows — nothing to check"]
+    if v["status"] == "no-baseline":
+        return True, [f"sentinel: no baseline rows match "
+                      f"(n_cores={v.get('n_cores')}, "
+                      f"backend={v.get('backend')}) — pass by default"]
+    lines = [f"sentinel: newest sha={v['sha']} vs "
+             f"{v['n_baseline']} baseline row(s), threshold "
+             f"{v['threshold']}x"]
+    for name, m in sorted(v["metrics"].items()):
+        verdict = "REGRESSION" if m["regressed"] else "ok"
+        lines.append(f"  {name}: {m['value']} vs median {m['median']} "
+                     f"(worse-ratio {m['worse_ratio']:.3f}, "
+                     f"gate {m['gate']:.3f}) {verdict}")
+    if v["regressions"]:
+        lines.append(f"sentinel: REGRESSION in {', '.join(v['regressions'])}")
         return False, lines
     lines.append("sentinel: ok")
     return True, lines
@@ -237,6 +273,11 @@ def main(argv=None) -> int:
     ap.add_argument("--window", type=int, default=None,
                     help=f"trailing baseline rows (default {DEFAULT_WINDOW} "
                          "or RDFIND_SENTINEL_WINDOW)")
+    ap.add_argument("--json", action="store_true",
+                    help="--check: print ONE machine-readable JSON verdict "
+                         "line (status, offending metrics, window size) "
+                         "instead of the prose report; exit codes are "
+                         "identical")
     args = ap.parse_args(argv)
     did = False
     if args.append is not None:
@@ -248,6 +289,11 @@ def main(argv=None) -> int:
               f"metrics={sorted(row['metrics'])}")
         did = True
     if args.check:
+        if args.json:
+            v = check_verdict(path=args.history, threshold=args.threshold,
+                              window=args.window)
+            print(json.dumps(v, sort_keys=True, default=str))
+            return 0 if v["ok"] else 1
         ok, lines = check(path=args.history, threshold=args.threshold,
                           window=args.window)
         print("\n".join(lines))

@@ -266,3 +266,12 @@ def counter(name: str, **values) -> None:
                               "ts": time.time_ns() // 1000, "args": values})
         return
     _TRACER.counter(name, values)
+
+
+def set_status(**kv) -> None:
+    """Attach extra fields to this host's heartbeat status — cap-utilization
+    fractions and forecast advisories ride the next beat so tpu_watch
+    --status can surface them.  No-op (one global check) when tracing is
+    off: the heartbeat file only exists under an armed tracer."""
+    if _TRACER is not None:
+        _TRACER._status.update(kv)

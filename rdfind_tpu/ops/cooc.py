@@ -781,6 +781,7 @@ def discover_pairs_dense(m, dep_count, cap_code, cap_v1, cap_v2, min_support,
     if block_skip_enabled() and l_pad % kl == 0 and c_pad % tile == 0 \
             and (n_line_blocks > 1 or len(los) > 1):
         block_counts = np.asarray(_stage_block_counts(m, kl=kl, tile=tile))
+    n_blocks_total = n_line_blocks * len(los)
     n_blocks_skipped = n_tiles_data_skipped = 0
     tile_blocks = {}
     if block_counts is not None:
@@ -800,11 +801,14 @@ def discover_pairs_dense(m, dep_count, cap_code, cap_v1, cap_v2, min_support,
                 n_blocks_skipped += n_line_blocks - nz.size
         los = kept
     if stats is not None:
-        from ..obs import metrics
+        from ..obs import datastats, metrics
         metrics.gauge_set(stats, "n_blocks_skipped", n_blocks_skipped)
         metrics.struct_update(stats, "dense_plan",
                               n_blocks_skipped=n_blocks_skipped,
                               n_tiles_data_skipped=n_tiles_data_skipped)
+        if datastats.enabled():
+            datastats.publish_block_skip(stats, n_blocks=n_blocks_total,
+                                         n_blocks_skipped=n_blocks_skipped)
 
     def make(lo):
         return lambda: (cooc_cind_tile(m, jnp.int32(lo), dep_count_d, code_d,

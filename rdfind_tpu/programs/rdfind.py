@@ -75,6 +75,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="obs: write the metrics registry as Prometheus text "
                         "exposition to FILE (atomically refreshed at every "
                         "stage boundary and at run end)")
+    p.add_argument("--console-port", type=int, default=None, metavar="PORT",
+                   help="obs: serve the live run console on this port "
+                        "(loopback HTTP: /metrics /status /progress "
+                        "/datastats /flightrec; 0 binds an ephemeral port, "
+                        "printed to stderr; RDFIND_CONSOLE_PORT is the env "
+                        "form)")
     p.add_argument("--counters", type=int, default=0, dest="counter_level")
     p.add_argument("--dop", type=int, default=1,
                    help="degree of parallelism = number of devices in the mesh")
@@ -255,6 +261,7 @@ def main(argv=None) -> int:
         interning=args.interning,
         trace_dir=args.trace_dir,
         metrics_file=args.metrics_file,
+        console_port=args.console_port,
     )
     # Un-silence the remaining compatibility no-ops (the reference's
     # JVM-dataflow levers that the TPU design subsumes).

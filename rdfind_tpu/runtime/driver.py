@@ -24,7 +24,7 @@ from ..dictionary import Dictionary, intern_triples
 from ..io import native, ntriples, prefixes, reader
 from ..models import allatonce, approximate, late_bb, sharded, small_to_large
 from ..obs import memory as obs_memory
-from ..obs import flightrec, metrics, report, tracer
+from ..obs import console, flightrec, metrics, report, tracer
 from ..parallel.mesh import make_mesh
 from . import checkpoint
 
@@ -77,6 +77,7 @@ class Config:
     interning: str = "auto"  # sharded-ingest dictionary: partitioned|replicated
     trace_dir: str | None = None  # obs: host span trace + heartbeat directory
     metrics_file: str | None = None  # obs: Prometheus text exposition file
+    console_port: int | None = None  # obs: live HTTP console (0 = ephemeral)
 
 
 @dataclasses.dataclass
@@ -97,6 +98,9 @@ class _Phases:
 
     def run(self, name, fn):
         t0 = time.perf_counter()
+        # Registry-only position gauge: the console's /progress reads it
+        # live; never written into a legacy stats dict.
+        metrics.gauge_set(None, "run_stage", name)
         with tracer.span(name, cat=tracer.CAT_STAGE):
             out = fn()
         self.timings[name] = time.perf_counter() - t0
@@ -637,11 +641,15 @@ def _obs_session(cfg: Config):
     --trace/RDFIND_TRACE names a directory, Prometheus exposition when
     --metrics-file/RDFIND_METRICS_FILE names a file), and tear it down —
     exporting the merged Chrome trace on the primary host — no matter how
-    the run ends.  With neither knob set this is a no-op and the run pays
-    only the disabled-path checks."""
+    the run ends.  The live console (--console-port/RDFIND_CONSOLE_PORT)
+    arms here too: one per-host HTTP server for the run's duration, port 0
+    binding an ephemeral port printed to stderr.  With no knob set this is
+    a no-op and the run pays only the disabled-path checks."""
     trace_dir = cfg.trace_dir or os.environ.get("RDFIND_TRACE") or None
     metrics_file = (cfg.metrics_file
                     or os.environ.get("RDFIND_METRICS_FILE") or None)
+    console_port = (cfg.console_port if cfg.console_port is not None
+                    else console.env_port())
     obs_memory.reset()
     flightrec.configure()  # re-read RDFIND_FLIGHTREC at every run start
     flightrec.reset()  # one run, one ring (dumps are per-incident anyway)
@@ -649,9 +657,22 @@ def _obs_session(cfg: Config):
         metrics.set_export(metrics_file)
     if trace_dir:
         tracer.start(trace_dir)
+    console_started = False
+    if console_port is not None:
+        bound = console.start(console_port, obs_dir=trace_dir)
+        if bound is None:
+            print(f"warning: run console could not bind port {console_port};"
+                  f" continuing without it", file=sys.stderr)
+        else:
+            console_started = True
+            print(f"rdfind: run console on http://{console.DEFAULT_HOST}:"
+                  f"{bound}/ (/metrics /status /progress /datastats "
+                  f"/flightrec)", file=sys.stderr, flush=True)
     try:
         yield
     finally:
+        if console_started:
+            console.stop()
         if metrics_file:
             try:
                 metrics.flush_export()

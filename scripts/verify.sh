@@ -46,6 +46,17 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+echo "== run console smoke (--console-port 0) =="
+# Tiny CPU run with an ephemeral console port: fetch /metrics + /progress
+# while it executes and assert both parse.  Skips itself (exit 0) when the
+# sandbox forbids loopback listening; VERIFY_SKIP_CONSOLE=1 skips outright.
+if [ "${VERIFY_SKIP_CONSOLE:-0}" = "1" ]; then
+    echo "verify: console smoke skipped (VERIFY_SKIP_CONSOLE=1)"
+elif ! JAX_PLATFORMS=cpu timeout -k 10 600 python scripts/console_smoke.py; then
+    echo "verify: console smoke FAILED" >&2
+    exit 1
+fi
+
 if [ "${VERIFY_SKIP_BENCH:-0}" = "1" ]; then
     echo "verify: tier-1 green; bench + sentinel skipped (VERIFY_SKIP_BENCH=1)"
     exit 0

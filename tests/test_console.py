@@ -1,0 +1,193 @@
+"""The live run console (obs/console.py): lifecycle, every endpoint over
+real loopback HTTP, bind-failure tolerance, consumer-gating of the data
+plane, and tpu_watch.py --console client mode."""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+
+from rdfind_tpu.models import sharded
+from rdfind_tpu.obs import console, datastats, heartbeat, metrics, tracer
+from rdfind_tpu.parallel.mesh import make_mesh
+from rdfind_tpu.utils.synth import generate_triples
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# One text-format sample line: name, optional labels, value.
+SAMPLE_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$")
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 CPU devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("RDFIND_CONSOLE_PORT", raising=False)
+    monkeypatch.delenv("RDFIND_DATASTATS", raising=False)
+    console.stop()
+    tracer.stop()
+    metrics.reset()
+    yield
+    console.stop()
+    tracer.stop()
+    metrics.reset()
+
+
+@pytest.fixture()
+def live_console():
+    port = console.start(0)
+    if port is None:
+        pytest.skip("sandbox forbids loopback listening")
+    yield f"http://127.0.0.1:{port}"
+
+
+def _get(base, path, timeout=10):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        body = r.read().decode("utf-8")
+        return r.status, r.headers.get("Content-Type", ""), body
+
+
+def _get_json(base, path):
+    _, _, body = _get(base, path)
+    return json.loads(body)
+
+
+def test_env_port_parsing(monkeypatch):
+    assert console.env_port() is None
+    monkeypatch.setenv("RDFIND_CONSOLE_PORT", "8080")
+    assert console.env_port() == 8080
+    monkeypatch.setenv("RDFIND_CONSOLE_PORT", "  0 ")
+    assert console.env_port() == 0
+    monkeypatch.setenv("RDFIND_CONSOLE_PORT", "junk")
+    assert console.env_port() is None
+    monkeypatch.setenv("RDFIND_CONSOLE_PORT", "")
+    assert console.env_port() is None
+
+
+def test_lifecycle_idempotent(live_console):
+    port = int(live_console.rsplit(":", 1)[1])
+    assert console.serving() and console.port() == port
+    assert console.start(0) == port  # idempotent: same server, same port
+    console.stop()
+    assert not console.serving() and console.port() is None
+    console.stop()  # stop on a stopped console is a no-op
+
+
+def test_bind_failure_returns_none():
+    with socket.socket() as s:
+        s.bind((console.DEFAULT_HOST, 0))
+        s.listen(1)
+        taken = s.getsockname()[1]
+        assert console.start(taken) is None
+    assert not console.serving()
+
+
+def test_metrics_endpoint_prometheus_text(live_console):
+    metrics.gauge_set(None, "run_stage", "pair-phase")
+    metrics.counter_add(None, "n_overflow_retries", 3)
+    code, ctype, body = _get(live_console, "/metrics")
+    assert code == 200 and ctype.startswith("text/plain")
+    samples = [ln for ln in body.splitlines()
+               if ln and not ln.startswith("#")]
+    assert samples, "no samples in /metrics"
+    for ln in samples:
+        assert SAMPLE_RE.match(ln), f"unparseable sample: {ln!r}"
+
+
+def test_progress_and_datastats_endpoints(live_console):
+    datastats.publish_cap_utilization(None, {"pairs": 100}, {"pairs": 80})
+    datastats.publish_line_stats(None, hist={2: 4}, n_lines=4, max_line=7,
+                                 source="single")
+    metrics.mapping_set(None, "cap_forecast", "pairs",
+                        {"cap": "pairs", "predicted_pass": 3})
+    metrics.gauge_set(None, "run_stage", "pair-phase")
+    metrics.gauge_set(None, "run_pass", 1)
+    prog = _get_json(live_console, "/progress")
+    assert prog["run_stage"] == "pair-phase" and prog["run_pass"] == 1
+    assert prog["cap_utilization"]["pairs"]["frac"] == 0.8
+    assert prog["cap_forecast"]["pairs"]["predicted_pass"] == 3
+    ds = _get_json(live_console, "/datastats")
+    assert set(ds) == {"datastats_lines"}  # only the datastats_* slice
+    assert ds["datastats_lines"]["n_lines"] == 4
+
+
+def test_status_flightrec_index_and_404(live_console, tmp_path):
+    status = _get_json(live_console, "/status")
+    assert status["serving"] is True and status["pid"] == os.getpid()
+    assert status["obs_dir"] is None and "heartbeat" not in status
+    heartbeat.write(str(tmp_path), {"stage": "pair-phase", "pass": 2})
+    console.set_obs_dir(str(tmp_path))
+    status = _get_json(live_console, "/status")
+    assert status["heartbeat"]["state"] == "alive"
+    assert status["heartbeat"]["hosts"]["0"]["stage"] == "pair-phase"
+    fr = _get_json(live_console, "/flightrec")
+    assert set(fr) == {"enabled", "events"}
+    index = _get_json(live_console, "/")
+    assert "/progress" in index["endpoints"]
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(live_console, "/nope")
+    assert exc.value.code == 404
+
+
+def test_console_is_a_datastats_consumer(live_console, mesh8):
+    """The PR-5 gating rule, third consumer: a live console alone (no env
+    knob, no tracer) arms the data plane, and /progress serves the run's
+    utilization while the process is still alive."""
+    assert datastats.enabled()
+    triples = generate_triples(300, seed=5, n_predicates=8, n_entities=32)
+    stats: dict = {}
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    assert stats["datastats_lines"]["source"] == "sharded"
+    prog = _get_json(live_console, "/progress")
+    assert prog["cap_utilization"]
+    assert prog["cap_utilization_passes"][0]["pass"] == 0
+    assert prog["run_pass"] is not None
+
+
+def _watch(args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tpu_watch.py")] + args,
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+
+
+def test_tpu_watch_console_client(live_console):
+    metrics.gauge_set(None, "run_stage", "pair-phase")
+    metrics.gauge_set(None, "run_pass", 0)
+    datastats.publish_cap_utilization(None, {"pairs": 100}, {"pairs": 80})
+    metrics.mapping_set(None, "cap_forecast", "pairs",
+                        {"cap": "pairs", "predicted_pass": 3, "n_pass": 4,
+                         "reason": "warn"})
+    hostport = live_console.split("://", 1)[1]  # client adds the scheme
+    r = _watch(["--console", hostport])
+    assert r.returncode == 0, r.stderr
+    assert f"pid {os.getpid()}" in r.stdout
+    assert "pair-phase pass 0" in r.stdout
+    assert "cap pairs: used 80/100" in r.stdout
+    assert "DEGRADING — cap pairs forecast exhausted at pass 3/4" in r.stdout
+    rj = _watch(["--console", live_console, "--json"])
+    assert rj.returncode == 0, rj.stderr
+    payload = json.loads(rj.stdout)
+    assert payload["url"] == live_console
+    assert payload["progress"]["cap_forecast"]["pairs"]["reason"] == "warn"
+    assert payload["status"]["serving"] is True
+
+
+def test_tpu_watch_console_unreachable():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))  # bound but never listening
+        port = s.getsockname()[1]
+    r = _watch(["--console", f"127.0.0.1:{port}"])
+    assert r.returncode == 2
+    assert "unreachable" in r.stdout

@@ -306,6 +306,78 @@ def test_tpu_watch_status_cli(tmp_path):
     assert r.returncode == 2
 
 
+def test_heartbeat_future_clock_is_fresh(tmp_path):
+    """A host whose clock runs ahead of the assessor produces a negative
+    age — trivially fresh, never wedged (multi-host clock skew must not
+    fabricate a stall)."""
+    d = str(tmp_path)
+    heartbeat.write(d, {"stage": "discover"}, host_index=0)
+    ts = heartbeat.read(d, 0)["ts"]
+    verdict = heartbeat.assess(d, stale_s=60, now=ts - 3600)
+    assert verdict["state"] == "alive"
+    assert verdict["hosts"][0]["age_s"] < 0
+
+
+def test_heartbeat_subset_of_hosts(tmp_path):
+    """Only hosts that wrote a file are assessed: a 2-host verdict from a
+    4-host run covers exactly the written hosts (the missing ones never
+    started their tracers — that is the 'missing' state only when NOBODY
+    wrote)."""
+    d = str(tmp_path)
+    heartbeat.write(d, {"stage": "discover"}, host_index=0)
+    heartbeat.write(d, {"stage": "discover"}, host_index=3)
+    verdict = heartbeat.assess(d, stale_s=60)
+    assert verdict["state"] == "alive"
+    assert sorted(verdict["hosts"]) == [0, 3]
+
+
+def test_heartbeat_final_but_stale_stays_done(tmp_path):
+    """A final beat never goes stale: all-final is 'done' at any age, and a
+    finished host must not flip a still-working peer's run to 'wedged'."""
+    d = str(tmp_path)
+    hb = heartbeat.Heartbeat(d, host_index=0)
+    hb.beat({"stage": "discover"}, final=True)
+    ts = heartbeat.read(d, 0)["ts"]
+    assert heartbeat.assess(d, stale_s=60, now=ts + 3600)["state"] == "done"
+    # A fresh non-final peer next to the old final host: alive, not wedged.
+    heartbeat.write(d, {"stage": "discover"}, host_index=1)
+    ts1 = heartbeat.read(d, 1)["ts"]
+    verdict = heartbeat.assess(d, stale_s=3600 * 2, now=ts1 + 5)
+    assert verdict["state"] == "alive"
+    # ...and once the non-final peer goes stale, THAT wedges the run.
+    assert heartbeat.assess(d, stale_s=1, now=ts1 + 3600)["state"] == "wedged"
+
+
+def test_tpu_watch_status_degrading(tmp_path):
+    """Satellite: --status flags 'degrading' (forecast advisory riding the
+    heartbeat) distinct from 'wedged', without changing the exit code."""
+    import subprocess
+    import sys
+
+    d = str(tmp_path)
+    heartbeat.write(d, {
+        "stage": "pair-phase", "pass": 1,
+        "cap_util": {"pass": 1, "pairs": 0.91},
+        "forecast": {"cap": "pairs", "predicted_pass": 3, "frac": 0.91,
+                     "reason": "warn"}}, host_index=0)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr  # degrading is NOT wedged: exit 0
+    assert "DEGRADING" in r.stdout and "cap pairs" in r.stdout
+    assert "cap utilization (pass 1): pairs=0.91" in r.stdout
+    assert "degrading: cap-exhaustion forecast active" in r.stdout
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d,
+         "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["degrading"] is True
+    assert payload["hosts"]["0"]["forecast"]["cap"] == "pairs"
+
+
 # ---------------------------------------------------------------------------
 # Disabled-path overhead.
 # ---------------------------------------------------------------------------

@@ -302,6 +302,58 @@ def test_sentinel_cli(tmp_path):
     assert "REGRESSION" in r.stdout
 
 
+def test_sentinel_check_verdict_statuses(tmp_path):
+    hist = str(tmp_path / "hist.jsonl")
+    v = sentinel.check_verdict(path=hist)
+    assert v["ok"] and v["status"] == "no-history"
+    sentinel.append(_fake_result(1.0, 1000.0), path=hist, backend="cpu")
+    v = sentinel.check_verdict(path=hist)
+    assert v["ok"] and v["status"] == "no-baseline"  # first row on a box
+    sentinel.append(_fake_result(1.0, 1000.0), path=hist, backend="cpu")
+    v = sentinel.check_verdict(path=hist)
+    assert v["status"] == "ok" and v["n_baseline"] == 1
+    assert v["metrics"]["headline_wall_s"]["regressed"] is False
+    sentinel.append(_fake_result(9.0, 100.0), path=hist, backend="cpu")
+    v = sentinel.check_verdict(path=hist)
+    assert not v["ok"] and v["status"] == "regression"
+    assert "headline_wall_s" in v["regressions"]
+
+
+def test_sentinel_cli_json(tmp_path):
+    """Satellite: --check --json emits ONE machine-readable verdict line
+    with exit-code parity against the prose mode."""
+    hist = str(tmp_path / "hist.jsonl")
+    for _ in range(3):
+        sentinel.append(_fake_result(1.0, 500.0), path=hist, backend="cpu")
+
+    def run_json():
+        return subprocess.run(
+            [sys.executable, "-m", "rdfind_tpu.obs.sentinel",
+             "--check", "--json", "--history", hist],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+
+    r = run_json()
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln]
+    assert len(lines) == 1  # ONE line, machine-readable
+    v = json.loads(lines[0])
+    assert v["ok"] is True and v["status"] == "ok"
+    assert v["window"] == sentinel.DEFAULT_WINDOW
+
+    sentinel.append(_fake_result(4.0, 100.0), path=hist, backend="cpu")
+    r = run_json()
+    assert r.returncode == 1  # parity with the prose exit code
+    v = json.loads(r.stdout.strip())
+    assert v["status"] == "regression"
+    assert "headline_wall_s" in v["regressions"]
+    assert v["metrics"]["headline_wall_s"]["worse_ratio"] > v["threshold"]
+    r_prose = subprocess.run(
+        [sys.executable, "-m", "rdfind_tpu.obs.sentinel",
+         "--check", "--history", hist],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert r_prose.returncode == r.returncode
+
+
 # ---------------------------------------------------------------------------
 # tpu_watch --json (satellite).
 # ---------------------------------------------------------------------------

@@ -170,17 +170,30 @@ def _flightrec_summaries(obs_dir: str) -> dict:
     return out
 
 
+def _degrading_hosts(hosts: dict) -> dict:
+    """{host: forecast-advisory} for hosts whose heartbeat carries a
+    cap-exhaustion forecast.  "Degrading" is a distinct verdict from
+    "wedged": spans are still closing (the run is alive), but a cap is
+    forecast to exhaust before the planned pass count — the degradation
+    ladder (grow/split/skip) is about to fire, not the tunnel."""
+    return {h: b["forecast"] for h, b in hosts.items()
+            if isinstance(b.get("forecast"), dict)}
+
+
 def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
     """The wedged-vs-slow verdict over a run's obs directory (exit codes:
-    0 alive/done, 1 wedged, 2 no heartbeat at all)."""
+    0 alive/done, 1 wedged, 2 no heartbeat at all; "degrading" is reported
+    but never changes the exit code — the run is still making progress)."""
     verdict = heartbeat.assess(obs_dir, stale_s=stale_s)
     state = verdict["state"]
     hosts = {
         h: {**b, "stale": b["age_s"] > stale_s and not b.get("final")}
         for h, b in verdict["hosts"].items()}
+    degrading = _degrading_hosts(hosts)
     recs = _flightrec_summaries(obs_dir)
     if as_json:
         print(json.dumps({"dir": obs_dir, "state": state,
+                          "degrading": bool(degrading),
                           "stale_s": stale_s, "age_s": verdict["age_s"],
                           "hosts": hosts, "flightrec": recs},
                          sort_keys=True, default=str))
@@ -197,6 +210,18 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
                  " (STALE)" if b["stale"] else "")
         print(f"status[{obs_dir}] host {h}: last event {b['age_s']}s ago "
               f"in {where}" + flags)
+        util = b.get("cap_util")
+        if isinstance(util, dict):
+            caps = ", ".join(f"{k}={v}" for k, v in sorted(util.items())
+                             if k != "pass")
+            print(f"status[{obs_dir}] host {h}: cap utilization "
+                  f"(pass {util.get('pass')}): {caps}")
+        fc = degrading.get(h)
+        if fc is not None:
+            print(f"status[{obs_dir}] host {h}: DEGRADING — cap "
+                  f"{fc.get('cap')} forecast exhausted at pass "
+                  f"{fc.get('predicted_pass')} ({fc.get('reason')}, frac "
+                  f"{fc.get('frac')})")
     # Surface the wedged host's flight recorder when one was dumped: the
     # ring of events leading into the stall, captured even with the jsonl
     # tracer off.
@@ -208,10 +233,57 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
         print(f"status[{obs_dir}] host {h}: flight recorder "
               f"({r['n_events']} events, reason={r['reason']!r}) at "
               f"{r['path']}; last: {', '.join(map(str, r['last_events']))}")
-    print(f"status[{obs_dir}]: {state}" + (
-        f" (no span boundary for > {stale_s:.0f}s — wedged, not slow)"
-        if state == "wedged" else ""))
+    tail = ""
+    if state == "wedged":
+        tail = f" (no span boundary for > {stale_s:.0f}s — wedged, not slow)"
+    elif degrading:
+        tail = (" (degrading: cap-exhaustion forecast active on host(s) "
+                f"{sorted(degrading)} — alive, but the degradation ladder "
+                "is imminent)")
+    print(f"status[{obs_dir}]: {state}" + tail)
     return 1 if state == "wedged" else 0
+
+
+def report_console(url: str, as_json: bool = False) -> int:
+    """Client mode for the live run console (rdfind --console-port): fetch
+    /status and /progress over HTTP and print the same alive/degrading
+    verdict shape as --status, but from the running process itself (exit
+    codes: 0 reachable, 1 run wedged per its own heartbeats, 2
+    unreachable)."""
+    import urllib.error
+    import urllib.request
+    base = url if "://" in url else "http://" + url
+    base = base.rstrip("/")
+    try:
+        with urllib.request.urlopen(base + "/status", timeout=10) as r:
+            status = json.load(r)
+        with urllib.request.urlopen(base + "/progress", timeout=10) as r:
+            progress = json.load(r)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"console[{url}]: unreachable ({e})")
+        return 2
+    if as_json:
+        print(json.dumps({"url": base, "status": status,
+                          "progress": progress}, sort_keys=True, default=str))
+    else:
+        hb = status.get("heartbeat") or {}
+        state = hb.get("state", "serving")
+        where = progress.get("run_stage")
+        if progress.get("run_pass") is not None:
+            where = f"{where} pass {progress['run_pass']}"
+        print(f"console[{base}]: pid {status.get('pid')} {state}, in {where}")
+        util = progress.get("cap_utilization") or {}
+        for cap, row in sorted(util.items()):
+            if isinstance(row, dict):
+                print(f"console[{base}]: cap {cap}: used "
+                      f"{row.get('used')}/{row.get('planned')} "
+                      f"(frac {row.get('frac')})")
+        for cap, adv in sorted((progress.get("cap_forecast") or {}).items()):
+            print(f"console[{base}]: DEGRADING — cap {cap} forecast "
+                  f"exhausted at pass {adv.get('predicted_pass')}"
+                  f"/{adv.get('n_pass')} ({adv.get('reason')})")
+    return 1 if (status.get("heartbeat") or {}).get("state") == "wedged" \
+        else 0
 
 
 def main() -> int:
@@ -230,10 +302,18 @@ def main() -> int:
                     help="--status: heartbeat age above which a run counts "
                          "as wedged")
     ap.add_argument("--json", action="store_true",
-                    help="--status: emit one machine-readable JSON line "
-                         "(state + per-host staleness + flight-recorder "
-                         "dump summaries) instead of prose")
+                    help="--status/--console: emit one machine-readable "
+                         "JSON line (state + per-host staleness + "
+                         "flight-recorder dump summaries) instead of prose")
+    ap.add_argument("--console", default=None, metavar="URL",
+                    help="query a live run console (rdfind --console-port) "
+                         "at URL (host:port or http://...) instead of "
+                         "reading heartbeat files: prints stage/pass, "
+                         "per-cap utilization, and any cap-exhaustion "
+                         "forecast (degrading ≠ wedged)")
     args = ap.parse_args()
+    if args.console is not None:
+        return report_console(args.console, as_json=args.json)
     if args.status is not None:
         return report_status(args.status, args.stale_s, as_json=args.json)
 
