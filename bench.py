@@ -246,14 +246,17 @@ def _bench_kernel_modes(backend: str) -> dict:
     verdict, each with an HBM watermark sample (obs/memory.py), so the
     "fused never materializes the cooc counts" claim is a measured number.
 
-    Plane rows rerun the packed-containment selfcheck at 8- and 4-bit
-    planes (the int4 row only engages where the backend probe lowers it —
-    elsewhere it records the emulated parity run).  Fused rows run the same
-    dense CIND sweep with RDFIND_FUSE_VERDICT off/on, fused FIRST, so a
-    higher HBM peak on the materialized row is attributable to the int32
-    cooc tile the fused kernel keeps in VMEM.  On backends without memory
-    stats (CPU) the hbm field is None and, off-TPU, the fused row shrinks
-    to a tiny interpreted parity check.
+    Plane rows rerun the packed-containment selfcheck across the full
+    rung-3 grid: {8,4,2}-bit planes x emit_pipeline off/on (sub-byte rows
+    only lower natively where the backend probe says so — elsewhere they
+    record the emulated parity run; the emit=on row off-TPU records the
+    probe refusing and the materialized path running, which is the
+    fallback contract under test).  Fused rows run the same dense CIND
+    sweep with RDFIND_FUSE_VERDICT off/on, fused FIRST, so a higher HBM
+    peak on the materialized row is attributable to the int32 cooc tile
+    the fused kernel keeps in VMEM.  On backends without memory stats
+    (CPU) the hbm field is None and, off-TPU, the fused row shrinks to a
+    tiny interpreted parity check.
     """
     import jax
     import jax.numpy as jnp
@@ -262,7 +265,9 @@ def _bench_kernel_modes(backend: str) -> dict:
     from rdfind_tpu.ops import cooc, sketch
 
     on_tpu = backend == "tpu"
-    out = {"modes": []}
+    # by_mode mirrors the rows keyed by mode name: the sentinel's _dig walks
+    # dicts only, so per-mode walls are only trackable through this view.
+    out = {"modes": [], "by_mode": {}}
 
     def hbm():
         rec = memory.sample(None, publish=False)
@@ -272,20 +277,38 @@ def _bench_kernel_modes(backend: str) -> dict:
             "delta_bytes": rec["delta_bytes"]}
 
     saved_pb, saved_fv = cooc.PLANE_BITS, cooc.FUSE_VERDICT
+    saved_em = cooc.EMIT_PIPELINE
     try:
-        for pb in ("8", "4"):
-            cooc.PLANE_BITS = pb
-            row = {"mode": f"planes{pb}",
-                   "kernel_dtype": cooc.resolved_kernel_dtype()}
-            try:
-                n = 2048 if on_tpu else 256
-                row.update(sketch.kernel_selfcheck(
-                    n_rows=n, n_bits=4096, backend=backend, repeats=3))
-            except Exception as e:
-                row["error"] = f"{type(e).__name__}: {e}"
-            row["hbm"] = hbm()
-            out["modes"].append(row)
+        baseline_hashes: dict = {}
+        for pb in ("8", "4", "2"):
+            for em in ("0", "1"):
+                cooc.PLANE_BITS = pb
+                cooc.EMIT_PIPELINE = em
+                row = {"mode": f"planes{pb}" + ("-emit" if em == "1" else ""),
+                       "kernel_dtype": cooc.resolved_kernel_dtype(),
+                       "emit_requested": em == "1"}
+                try:
+                    n = 2048 if on_tpu else 256
+                    row.update(sketch.kernel_selfcheck(
+                        n_rows=n, n_bits=4096, backend=backend, repeats=3))
+                    # All six rows run the identical logical contraction:
+                    # the paired emit row must reproduce its non-emit
+                    # sibling bit-for-bit (off-TPU the probe refuses and
+                    # the emit row IS the materialized path — the check
+                    # then proves the fallback contract instead).
+                    if "out_hash" in row:
+                        if pb in baseline_hashes:
+                            row["outputs_identical"] = (
+                                row["out_hash"] == baseline_hashes[pb])
+                        else:
+                            baseline_hashes[pb] = row["out_hash"]
+                except Exception as e:
+                    row["error"] = f"{type(e).__name__}: {e}"
+                row["hbm"] = hbm()
+                out["modes"].append(row)
+                out["by_mode"][row["mode"]] = row
         cooc.PLANE_BITS = saved_pb
+        cooc.EMIT_PIPELINE = saved_em
 
         # Fused-verdict rows share one membership matrix; the sweep is the
         # full scheduled dep-tile pass of discover_pairs_dense.
@@ -322,8 +345,10 @@ def _bench_kernel_modes(backend: str) -> dict:
             else:
                 row["outputs_identical"] = pairs == baseline
             out["modes"].append(row)
+            out["by_mode"][row["mode"]] = row
     finally:
         cooc.PLANE_BITS, cooc.FUSE_VERDICT = saved_pb, saved_fv
+        cooc.EMIT_PIPELINE = saved_em
     return out
 
 
@@ -502,6 +527,140 @@ def _bench_exchange(min_support: int) -> dict:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+    return out
+
+
+def _kernel_feed_row(n_mesh: int, min_support: int) -> dict:
+    """One kernel-feed row: the sharded dense pass on an n_mesh-device mesh
+    with the skew meter armed through the metrics exposition gate (NOT
+    RDFIND_COLLECTIVE_TIMING — that serializes the executor and would
+    destroy the very overlap this row measures).  Reports pairs/s/chip,
+    the executor's measured overlap_efficiency, and the kernel-feed stall
+    fraction: exchange-wait ms over dense-compute ms, summed across hosts
+    from the _SkewMeter phase timers (>= 1.0 means the dense kernels are
+    exchange-bound — feeding the MXU is the bottleneck, not the matmul).
+    """
+    import tempfile
+
+    from rdfind_tpu.models import sharded
+    from rdfind_tpu.obs import metrics as obs_metrics
+    from rdfind_tpu.parallel.mesh import make_mesh
+    from rdfind_tpu.utils.synth import generate_triples
+
+    n = int(os.environ.get("BENCH_KERNEL_FEED_TRIPLES", 4_000))
+    triples = generate_triples(n, seed=53)
+    mesh = make_mesh(n_mesh)
+    row = {"mesh_devices": int(mesh.devices.size), "n_triples": n}
+    prev_export = obs_metrics.export_path()
+    tmp = tempfile.NamedTemporaryFile(suffix=".prom", delete=False)
+    tmp.close()
+    obs_metrics.set_export(tmp.name)
+    try:
+        stats: dict = {}
+        sharded.discover_sharded(triples, min_support, mesh=mesh,
+                                 stats=stats)  # warm (compile)
+        stats = {}
+        t0 = time.perf_counter()
+        table = sharded.discover_sharded(triples, min_support, mesh=mesh,
+                                         stats=stats)
+        wall = time.perf_counter() - t0
+    finally:
+        obs_metrics.set_export(prev_export)
+        try:
+            os.unlink(tmp.name)
+        except OSError:
+            pass
+    pairs = int(stats.get("total_pairs", 0))
+    ov = stats.get("overlap") or {}
+    row.update({
+        "wall_s": round(wall, 3),
+        "total_pairs": pairs,
+        "pairs_per_sec_per_chip": round(
+            pairs / max(wall, 1e-9) / max(n_mesh, 1), 1),
+        "cinds": len(table),
+        "overlap_efficiency": ov.get("overlap_efficiency"),
+        "kernel_feed_stall_fraction": obs_report.kernel_feed_stall_fraction(
+            stats.get("host_skew")),
+        "host_skew": stats.get("host_skew"),
+        **obs_report.dispatch_row(stats),
+    })
+    return row
+
+
+def _kernel_feed_subprocess(n_mesh: int, timeout_s: int = 1800) -> dict:
+    """Run one kernel-feed row in a child process with
+    --xla_force_host_platform_device_count (the in-process backend cannot
+    grow its device count after init).  The child is bench.py itself in
+    BENCH_KERNEL_FEED_WORKER mode; its last stdout line is the row JSON.
+    """
+    import subprocess
+
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        flags += f" --xla_force_host_platform_device_count={n_mesh}"
+    # NB: no --xla_cpu_collective_*timeout* flags here — this image's XLA
+    # rejects them at startup (F parse_flags_from_env).  The fake devices
+    # share one executable, so collectives are intra-program; the
+    # subprocess timeout is the only stuck-guard needed.
+    env["XLA_FLAGS"] = flags.strip()
+    env["BENCH_BACKEND"] = "cpu"
+    env["BENCH_KERNEL_FEED_WORKER"] = str(n_mesh)
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True, env=env,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"mesh_devices": n_mesh, "proxy": True,
+                "error": f"worker timed out after {timeout_s}s"}
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        return {"mesh_devices": n_mesh, "proxy": True,
+                "error": tail[-1] if tail else f"worker rc={r.returncode}"}
+    try:
+        row = json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        return {"mesh_devices": n_mesh, "proxy": True,
+                "error": f"unparseable worker output: {type(e).__name__}: {e}"}
+    row["proxy"] = True
+    return row
+
+
+def _bench_kernel_feed(min_support: int) -> dict:
+    """Multi-chip kernel-feed rows (rung 3): the sharded dense pass at mesh
+    sizes 1 and 8, asking whether the exchange plane can keep the dense
+    kernels fed as chips are added.  Each row reports pairs/s/chip, the
+    executor's overlap_efficiency, and the kernel-feed stall fraction.
+    Mesh sizes the in-process backend cannot supply run in a forced-
+    device-count CPU subprocess (8 fake devices on one core — per-chip
+    absolutes are meaningless there; the row validates the measurement
+    STRUCTURE, and scaling_efficiency is only computed when both rows ran
+    on real same-process devices).  Pod-slice rows are reserved for
+    tpu_watch captures on the real machine.
+    """
+    import jax
+
+    avail = int(jax.device_count())
+    out = {"n_devices_available": avail, "rows": []}
+    for n_mesh in (1, 8):
+        if n_mesh <= avail:
+            try:
+                row = _kernel_feed_row(n_mesh, min_support)
+                row["proxy"] = False
+            except Exception as e:
+                row = {"mesh_devices": n_mesh,
+                       "error": f"{type(e).__name__}: {e}"}
+        else:
+            row = _kernel_feed_subprocess(n_mesh)
+        out["rows"].append(row)
+        # Dict view for the sentinel (its _dig walks dicts only).
+        out[f"mesh{n_mesh}"] = row
+    real = [r for r in out["rows"]
+            if not r.get("proxy") and r.get("pairs_per_sec_per_chip")]
+    if len(real) >= 2:
+        out["scaling_efficiency"] = round(
+            real[-1]["pairs_per_sec_per_chip"]
+            / real[0]["pairs_per_sec_per_chip"], 3)
     return out
 
 
@@ -735,6 +894,15 @@ def _run(n: int, min_support: int) -> dict:
     except Exception as e:
         detail["exchange"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # Multi-chip kernel-feed rows (rung 3): the sharded dense pass at mesh
+    # 1 and 8 — pairs/s/chip, overlap efficiency, and how long the dense
+    # kernels starved on exchange (stall fraction).  Mesh sizes beyond the
+    # local device count run on the forced-device-count CPU subprocess.
+    try:
+        detail["kernel_feed"] = _bench_kernel_feed(min_support)
+    except Exception as e:
+        detail["kernel_feed"] = {"error": f"{type(e).__name__}: {e}"}
+
     # Parallel native ingest vs the serial engine (front-door throughput:
     # triples/s, bytes/s, per-phase ms, identical-output check).
     try:
@@ -811,7 +979,9 @@ def _run(n: int, min_support: int) -> dict:
         # watermark sample (rung-2 acceptance: the fused row's peak must
         # undercut the materialized row's by the cooc tile it never writes).
         try:
-            pk["modes"] = _bench_kernel_modes(backend)["modes"]
+            km = _bench_kernel_modes(backend)
+            pk["modes"] = km["modes"]
+            pk["modes_by_name"] = km["by_mode"]
         except Exception as e:
             pk["modes"] = {"error": f"{type(e).__name__}: {e}"}
         detail["pallas_vs_jnp"] = pk
@@ -847,6 +1017,45 @@ def main():
     n = int(os.environ.get("BENCH_TRIPLES", 200_000))
     min_support = int(os.environ.get("BENCH_MIN_SUPPORT", 10))
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if os.environ.get("BENCH_KERNEL_FEED_WORKER"):
+        # Child of _kernel_feed_subprocess: one kernel-feed row on the
+        # forced-device-count backend, row JSON on stdout, no history.
+        n_mesh = int(os.environ["BENCH_KERNEL_FEED_WORKER"])
+        try:
+            _init_backend()
+            row = _kernel_feed_row(n_mesh, min_support)
+        except Exception as e:
+            row = {"mesh_devices": n_mesh,
+                   "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(row))
+        return
+    if os.environ.get("BENCH_KERNEL_MODES_ONLY"):
+        # Fast standalone artifact for the rung-3 kernel-mode grid (plane
+        # bits x emit_pipeline x fused): no oracle, no headline discovery —
+        # the cheap rows tpu_watch captures FIRST on a freshly live tunnel,
+        # before risking the long benches.  Same detail shape bench.py
+        # embeds under detail.pallas_vs_jnp, promoted to the headline.
+        try:
+            backend = _init_backend()
+            km = _bench_kernel_modes(backend)
+            walls = [r["pallas_ms"] for r in km["modes"]
+                     if isinstance(r.get("pallas_ms"), (int, float))]
+            result = {
+                "metric": "kernel_mode_best_pallas_ms",
+                "value": min(walls) if walls else 0,
+                "unit": "ms", "vs_baseline": 1.0,
+                "detail": {"backend": backend,
+                           "pallas_vs_jnp": {"modes": km["modes"],
+                                             "modes_by_name": km["by_mode"]},
+                           "obs": obs.snapshot()},
+            }
+        except Exception as e:
+            result = {"metric": "kernel_mode_best_pallas_ms", "value": 0,
+                      "unit": "ms", "vs_baseline": 0,
+                      "detail": {"error": f"{type(e).__name__}: {e}"}}
+        print(json.dumps(result))
+        _record_history(result)
+        return
     if os.environ.get("BENCH_INGEST_ONLY"):
         # Fast standalone artifact for the ingest row (no jax warm-up, no
         # discovery): the same JSON shape bench.py embeds under

@@ -12,7 +12,8 @@ measurement machinery, AbstractFlinkProgram.java:65-77,175-182): one row per
             unary+binary, support >= 100.
 
 Usage: python bench_matrix.py [--configs 1,2] [--strategies 0,1,2,3]
-                              [--dtypes int8,bf16] [--hier off,0,1]
+                              [--dtypes int8,bf16] [--plane-bits 8,4,2]
+                              [--emit 0,1] [--hier off,0,1]
 Prints one JSON line per row, then a summary table on stderr.  --dtypes adds
 one row per cooc membership dtype (int8 rides the doubled int8 MXU peak and
 is exact via int32 accumulation; pass "auto" for the probe-resolved default).
@@ -52,7 +53,8 @@ CONFIGS = {
 
 def run_one(config_id: int, strategy: int, dtype: str = "auto",
             plane_bits: str = "auto", fuse: str = "auto",
-            hier: str = "off", hier_hosts: int = 2) -> dict:
+            emit: str = "auto", hier: str = "off",
+            hier_hosts: int = 2) -> dict:
     from rdfind_tpu.models import (allatonce, approximate, late_bb,
                                    small_to_large)
     from rdfind_tpu.ops import cooc
@@ -66,11 +68,13 @@ def run_one(config_id: int, strategy: int, dtype: str = "auto",
 
     if dtype not in ("auto", "bf16", "int8"):
         raise ValueError(f"dtype must be auto, bf16 or int8, got {dtype!r}")
-    if plane_bits not in ("auto", "4", "8"):
-        raise ValueError(f"plane bits must be auto, 4 or 8, "
+    if plane_bits not in ("auto", "2", "4", "8"):
+        raise ValueError(f"plane bits must be auto, 2, 4 or 8, "
                          f"got {plane_bits!r}")
     if fuse not in ("auto", "0", "1"):
         raise ValueError(f"fuse must be auto, 0 or 1, got {fuse!r}")
+    if emit not in ("auto", "0", "1"):
+        raise ValueError(f"emit must be auto, 0 or 1, got {emit!r}")
     if hier not in ("off", "0", "1", "auto"):
         raise ValueError(f"hier must be off, 0, 1 or auto, got {hier!r}")
 
@@ -94,11 +98,12 @@ def run_one(config_id: int, strategy: int, dtype: str = "auto",
         run = lambda stats: sharded_fn(triples, spec["min_support"],  # noqa: E731
                                        mesh=mesh, use_fis=True, stats=stats)
 
-    saved = (cooc.COOC_DTYPE, cooc.PLANE_BITS, cooc.FUSE_VERDICT)
+    saved = (cooc.COOC_DTYPE, cooc.PLANE_BITS, cooc.FUSE_VERDICT,
+             cooc.EMIT_PIPELINE)
     saved_env = {k: os.environ.get(k)
                  for k in ("RDFIND_HIER_EXCHANGE", "RDFIND_HIER_HOSTS")}
-    cooc.COOC_DTYPE, cooc.PLANE_BITS, cooc.FUSE_VERDICT = (dtype, plane_bits,
-                                                           fuse)
+    (cooc.COOC_DTYPE, cooc.PLANE_BITS, cooc.FUSE_VERDICT,
+     cooc.EMIT_PIPELINE) = (dtype, plane_bits, fuse, emit)
     try:
         if hier != "off":
             os.environ["RDFIND_HIER_EXCHANGE"] = hier
@@ -120,9 +125,12 @@ def run_one(config_id: int, strategy: int, dtype: str = "auto",
                 "exchange_bytes": sum(e["bytes"] for e in sites.values()),
                 "ici_bytes": sum(e["ici_bytes"] for e in sites.values()),
                 "dcn_bytes": sum(e["dcn_bytes"] for e in sites.values()),
+                "overlap_efficiency": (stats.get("overlap")
+                                       or {}).get("overlap_efficiency"),
             }
     finally:
-        cooc.COOC_DTYPE, cooc.PLANE_BITS, cooc.FUSE_VERDICT = saved
+        (cooc.COOC_DTYPE, cooc.PLANE_BITS, cooc.FUSE_VERDICT,
+         cooc.EMIT_PIPELINE) = saved
         for k, v in saved_env.items():
             if v is None:
                 os.environ.pop(k, None)
@@ -138,6 +146,9 @@ def run_one(config_id: int, strategy: int, dtype: str = "auto",
         "cooc_dtype": stats.get("cooc_dtype", dtype),
         "plane_bits": stats.get("plane_bits"),
         "fuse_verdict": fuse,
+        # The full knob->decision struct (probes included): one glance says
+        # what kernel actually ran in this cell.
+        "kernel_resolution": stats.get("kernel_resolution"),
         "n_blocks_skipped": stats.get("n_blocks_skipped"),
         "dense_plan": stats.get("dense_plan"),
         "wall_s": round(wall, 3),
@@ -159,10 +170,15 @@ def main():
                          "(int8 | bf16 | auto)")
     ap.add_argument("--plane-bits", default="auto",
                     help="containment-kernel plane widths, one row each "
-                         "(8 | 4 | auto; 4 = nibble planes where the int4 "
-                         "MXU path lowers)")
+                         "(8 | 4 | 2 | auto; 4 = nibble planes, 2 = crumb "
+                         "planes, each engaging natively only where the "
+                         "matching MXU probe lowers)")
     ap.add_argument("--fuse", default="auto",
                     help="fused-verdict modes, one row each (0 | 1 | auto)")
+    ap.add_argument("--emit", default="auto",
+                    help="emit_pipeline K-loop modes for the packed "
+                         "containment kernel, one row each (0 | 1 | auto; "
+                         "falls back byte-identically off TPU)")
     ap.add_argument("--hier", default="off",
                     help="pod-scale exchange modes, one row each (off = "
                          "single-device models; 0 | 1 | auto = sharded "
@@ -189,25 +205,29 @@ def main():
             for dtype in args.dtypes.split(","):
                 for pb in args.plane_bits.split(","):
                     for fuse in args.fuse.split(","):
-                        for hier in args.hier.split(","):
-                            try:
-                                row = run_one(cid, strat,
-                                              dtype=dtype.strip(),
-                                              plane_bits=pb.strip(),
-                                              fuse=fuse.strip(),
-                                              hier=hier.strip(),
-                                              hier_hosts=args.hier_hosts)
-                            except Exception as e:  # keep reporting the rest
-                                row = {"config": cid, "strategy": strat,
-                                       "cooc_dtype": dtype.strip(),
-                                       "plane_bits": pb.strip(),
-                                       "fuse_verdict": fuse.strip(),
-                                       "hier": hier.strip(),
-                                       "error": f"{type(e).__name__}: {e}"}
-                            row["backend"] = backend
-                            row["provenance"] = prov
-                            rows.append(row)
-                            print(json.dumps(row), flush=True)
+                        for emit in args.emit.split(","):
+                            for hier in args.hier.split(","):
+                                try:
+                                    row = run_one(cid, strat,
+                                                  dtype=dtype.strip(),
+                                                  plane_bits=pb.strip(),
+                                                  fuse=fuse.strip(),
+                                                  emit=emit.strip(),
+                                                  hier=hier.strip(),
+                                                  hier_hosts=args.hier_hosts)
+                                except Exception as e:  # keep reporting
+                                    row = {"config": cid, "strategy": strat,
+                                           "cooc_dtype": dtype.strip(),
+                                           "plane_bits": pb.strip(),
+                                           "fuse_verdict": fuse.strip(),
+                                           "emit_pipeline": emit.strip(),
+                                           "hier": hier.strip(),
+                                           "error":
+                                               f"{type(e).__name__}: {e}"}
+                                row["backend"] = backend
+                                row["provenance"] = prov
+                                rows.append(row)
+                                print(json.dumps(row), flush=True)
 
     print(f"{'cfg':>3} {'strat':>5} {'dtype':>5} {'wall_s':>9} "
           f"{'Mpairs/s':>9} {'cinds':>8}", file=sys.stderr)

@@ -386,6 +386,8 @@ def _discover_dense(triples, padded, n, min_support, projections, use_fc_filter,
         metrics.gauge_set(stats, "cooc_dtype", plan.dtype)
         metrics.gauge_set(stats, "plane_bits", plan.plane_bits)
         metrics.gauge_set(stats, "fuse_verdict", plan.fuse_verdict)
+        metrics.struct_set(stats, "kernel_resolution",
+                           cooc.resolution_report())
 
     # The fused-verdict sweep always runs tiled (its kernel is the tile
     # dispatch); the one-dispatch single-shot program is the materialized

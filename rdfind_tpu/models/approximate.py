@@ -212,6 +212,8 @@ def _dense_verify_counts(line_val_h, line_cap_h, num_caps, cand_dep, cand_ref,
         metrics.struct_set(stats, "dense_plan", plan.describe())
         metrics.gauge_set(stats, "cooc_dtype", plan.dtype)
         metrics.gauge_set(stats, "plane_bits", plan.plane_bits)
+        metrics.struct_set(stats, "kernel_resolution",
+                           cooc_ops.resolution_report())
 
     row_cap = segments.pow2_capacity(n)
     pad = allatonce._pad_np

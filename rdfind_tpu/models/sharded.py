@@ -1141,6 +1141,8 @@ class _Pipeline:
                               cooc_ops.resolved_cooc_dtype())
             metrics.gauge_set(stats, "plane_bits",
                               cooc_ops.resolved_plane_bits())
+            metrics.struct_set(stats, "kernel_resolution",
+                               cooc_ops.resolution_report())
 
         # Data plane (obs/datastats.py): the one-shot distribution snapshot
         # (on-device log2 histograms over the resident lines + capture
@@ -1651,15 +1653,18 @@ class _Pipeline:
             *cols, n_out, tele = out
             return cols, n_out, tele
 
-        blocks, (ngl, ngp, _) = self._run_passes(step, "pair-phase",
-                                                 site="cind",
-                                                 phase_key="cind")
+        blocks, (ngl, ngp, npt) = self._run_passes(step, "pair-phase",
+                                                   site="cind",
+                                                   phase_key="cind")
         if self.stats is not None:
             # max across passes: a mid-run cap_p growth shifts the giant
             # threshold between passes, so the last pass may see fewer giants
             # than an earlier one (ADVICE r5).
             metrics.gauge_set(self.stats, "n_giant_lines", max(ngl))
             metrics.gauge_set(self.stats, "n_giant_pairs", sum(ngp))
+            # Emitted-pairs total (same stat the single-device models
+            # publish): the pairs/s/chip numerator of the kernel-feed rows.
+            metrics.counter_add(self.stats, "total_pairs", sum(npt))
         return blocks
 
     def run_cooc(self, fcode, fv1, fv2, fflag, n_flags, stat_key):

@@ -449,6 +449,8 @@ def _prepare_dense(padded, n, min_support, projections, use_fc_filter, use_ars,
             pair_backend="matmul",
             dense_plan=plan.describe(), cooc_dtype=plan.dtype,
             plane_bits=plan.plane_bits)
+        metrics.struct_set(stats, "kernel_resolution",
+                           cooc_ops.resolution_report())
         if datastats.enabled():
             datastats.publish_line_stats(
                 stats, hist=datastats.log2_bucket_counts(lens64),

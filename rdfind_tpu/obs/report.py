@@ -217,11 +217,21 @@ def format_debug_lines(stats: dict) -> list[str]:
     if "dense_plan" in stats:
         # Dense cooc occupancy: the roofline-correcting record (issued vs
         # real FLOPs of the scheduled tile sweep) plus the resolved dtype.
+        # The kernel_resolution struct (cooc.resolution_report) folds the
+        # remaining kernel-mode decisions — emit-pipeline K schedule and
+        # the actual plane element type — onto the same line, so every
+        # plane-bits/emit/fuse choice reads in one place.
         dp = stats["dense_plan"]
+        kr = stats.get("kernel_resolution", {})
+        res = ""
+        if kr:
+            res = (f"kernel={kr.get('kernel_dtype')}"
+                   f"/{kr.get('plane_elem')} "
+                   f"emit={1 if kr.get('emit_pipeline') else 0} ")
         lines.append(
             f"dense plan: dtype={stats.get('cooc_dtype')} "
             f"policy={dp['policy']} "
-            f"planes={dp.get('plane_bits', 8)}b "
+            f"planes={dp.get('plane_bits', 8)}b " + res +
             f"fused={1 if dp.get('fuse_verdict') else 0} "
             f"lines={dp['l_real']}/{dp['l_pad']} "
             f"caps={dp['c_real']}/{dp['c_pad']} tile={dp['tile']} "
@@ -230,7 +240,16 @@ def format_debug_lines(stats: dict) -> list[str]:
             f"blocks_skipped={dp.get('n_blocks_skipped', 0)}"
             f"/{dp.get('n_blocks', 0)} occupancy={dp['occupancy']}")
     elif "cooc_dtype" in stats:
-        lines.append(f"cooc dtype: {stats['cooc_dtype']}")
+        kr = stats.get("kernel_resolution", {})
+        if kr:
+            lines.append(
+                f"cooc dtype: {stats['cooc_dtype']} "
+                f"planes={kr.get('plane_bits')}b "
+                f"kernel={kr.get('kernel_dtype')}/{kr.get('plane_elem')} "
+                f"emit={1 if kr.get('emit_pipeline') else 0} "
+                f"fused={1 if kr.get('fuse_verdict') else 0}")
+        else:
+            lines.append(f"cooc dtype: {stats['cooc_dtype']}")
     if "n_host_syncs" in stats:
         # Dispatch telemetry of the pipelined pass executor: proof the
         # compute/readback overlap happened, not an assertion of it.
@@ -388,6 +407,30 @@ def dispatch_row(stats: dict) -> dict:
     from the canonical key groups so bench, driver and tests cannot drift."""
     return {k: stats.get(k)
             for k in metrics.DISPATCH_KEYS + metrics.FAULT_KEYS[:3]}
+
+
+def kernel_feed_stall_fraction(host_skew: dict | None) -> float | None:
+    """Kernel-feed stall fraction: exchange-wait ms ÷ dense-compute ms.
+
+    Derived from the _SkewMeter phase vectors (stats["host_skew"]
+    ["phase_ms"], per-host totals over the committed passes): the fraction
+    of the dense compute wall the exchange machinery spends feeding it.
+    0.1 means the exchange costs 10% of the kernel time it feeds — the
+    PR-8 hierarchical exchange "can feed the kernel"; >= 1.0 means the
+    sweep is exchange-bound and more chips will not help until the feed
+    path improves.  Summed across hosts so multi-host skew does not hide
+    in a mean.  None when the meter never armed (no obs consumer) or no
+    compute was recorded — callers must treat absence as "not measured",
+    never as 0 (a genuinely stall-free run reports 0.0, not None)."""
+    phases = (host_skew or {}).get("phase_ms") or {}
+    exchange = phases.get("exchange")
+    compute = phases.get("compute")
+    if not exchange or not compute:
+        return None
+    compute_ms = float(sum(compute))
+    if compute_ms <= 0:
+        return None
+    return round(float(sum(exchange)) / compute_ms, 4)
 
 
 def main(argv=None) -> int:

@@ -68,6 +68,52 @@ METRIC_SPECS = (
      ("detail", "ingest", "parallel", "triples_per_sec"), "higher"),
     ("ingest_parse_speedup_vs_legacy",
      ("detail", "ingest", "parse_speedup_vs_legacy"), "higher"),
+    # Rung-3 kernel-mode walls (plane bits x emit_pipeline K-loop), from the
+    # dict view of the per-mode rows (bench modes_by_name).  TPU-only in
+    # practice: the CPU parity rows carry no pallas_ms, so extract() simply
+    # skips them there.
+    ("kernel_planes8_pallas_ms",
+     ("detail", "pallas_vs_jnp", "modes_by_name", "planes8", "pallas_ms"),
+     "lower"),
+    ("kernel_planes8_emit_pallas_ms",
+     ("detail", "pallas_vs_jnp", "modes_by_name", "planes8-emit",
+      "pallas_ms"), "lower"),
+    ("kernel_planes4_pallas_ms",
+     ("detail", "pallas_vs_jnp", "modes_by_name", "planes4", "pallas_ms"),
+     "lower"),
+    ("kernel_planes4_emit_pallas_ms",
+     ("detail", "pallas_vs_jnp", "modes_by_name", "planes4-emit",
+      "pallas_ms"), "lower"),
+    ("kernel_planes2_pallas_ms",
+     ("detail", "pallas_vs_jnp", "modes_by_name", "planes2", "pallas_ms"),
+     "lower"),
+    ("kernel_planes2_emit_pallas_ms",
+     ("detail", "pallas_vs_jnp", "modes_by_name", "planes2-emit",
+      "pallas_ms"), "lower"),
+    ("kernel_fused_wall_s",
+     ("detail", "pallas_vs_jnp", "modes_by_name", "fused", "wall_s"),
+     "lower"),
+    ("kernel_materialized_wall_s",
+     ("detail", "pallas_vs_jnp", "modes_by_name", "materialized", "wall_s"),
+     "lower"),
+    # Multi-chip kernel-feed rows (rung 3): per-chip throughput and the
+    # stall fraction (exchange-wait ms / dense-compute ms — "can the
+    # exchange plane keep the kernels fed"; lower is better, >= 1 means
+    # exchange-bound).  overlap/scaling efficiencies regress downward.
+    ("kernel_feed_mesh1_pairs_per_sec_per_chip",
+     ("detail", "kernel_feed", "mesh1", "pairs_per_sec_per_chip"), "higher"),
+    ("kernel_feed_mesh8_pairs_per_sec_per_chip",
+     ("detail", "kernel_feed", "mesh8", "pairs_per_sec_per_chip"), "higher"),
+    ("kernel_feed_mesh1_stall_fraction",
+     ("detail", "kernel_feed", "mesh1", "kernel_feed_stall_fraction"),
+     "lower"),
+    ("kernel_feed_mesh8_stall_fraction",
+     ("detail", "kernel_feed", "mesh8", "kernel_feed_stall_fraction"),
+     "lower"),
+    ("kernel_feed_mesh8_overlap_efficiency",
+     ("detail", "kernel_feed", "mesh8", "overlap_efficiency"), "higher"),
+    ("kernel_feed_scaling_efficiency",
+     ("detail", "kernel_feed", "scaling_efficiency"), "higher"),
 )
 _DIRECTIONS = {name: d for name, _, d in METRIC_SPECS}
 

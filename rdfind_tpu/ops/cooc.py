@@ -70,18 +70,20 @@ TILE_SCHEDULE = os.environ.get("RDFIND_TILE_SCHEDULE", "1").lower() \
     not in ("0", "false", "no")
 
 # Membership-plane width of the packed containment kernel
-# (ops/pallas_kernels.py).  "auto" (default) resolves to 4 — nibble-packed
-# int4 planes, doubling the K-dim each MXU pass covers at the same VMEM
-# budget — only where the backend's int4 matmul path both lowers and pays
-# off (the TPU MXU; the probe mirrors _int8_pays_off), and to 8 everywhere
-# else, so non-TPU backends keep today's behavior untouched.  "8" pins the
-# PR-2 int8 planes unconditionally; "4" forces the nibble-WK mode (on
-# backends without native int4 elements it runs with int8 elements — the
-# same doubled-WK grid, bit-identical, for differential testing).  Exactness
-# is unchanged in every mode: planes are 0/1, accumulation stays int32.
+# (ops/pallas_kernels.py).  "auto" (default) resolves to the narrowest
+# sub-byte mode whose matmul path both lowers and pays off on this backend
+# (the probes mirror _int8_pays_off): 2 — crumb-packed int2 planes, WK 1024,
+# quadrupling int8's contraction lanes per MXU pass at the same VMEM
+# budget — where the int2 path engages, else 4 (nibble int4 planes, WK 512),
+# else 8 everywhere else, so non-TPU backends keep today's behavior
+# untouched.  "8" pins the PR-2 int8 planes unconditionally; "4"/"2" force
+# the widened-WK modes (on backends without native sub-byte elements they
+# run with int8 elements — the same widened-WK grid, bit-identical, for
+# differential testing).  Exactness is unchanged in every mode: planes are
+# 0/1, accumulation stays int32.
 PLANE_BITS = os.environ.get("RDFIND_PLANE_BITS", "auto")
-if PLANE_BITS not in ("auto", "4", "8"):
-    raise ValueError(f"RDFIND_PLANE_BITS must be auto, 4 or 8, "
+if PLANE_BITS not in ("auto", "2", "4", "8"):
+    raise ValueError(f"RDFIND_PLANE_BITS must be auto, 2, 4 or 8, "
                      f"got {PLANE_BITS!r}")
 
 # Fused verdict + minimality pre-filter on the dense CIND sweep: compute
@@ -97,6 +99,22 @@ FUSE_VERDICT = os.environ.get("RDFIND_FUSE_VERDICT", "auto")
 if FUSE_VERDICT not in ("auto", "0", "1"):
     raise ValueError(f"RDFIND_FUSE_VERDICT must be auto, 0 or 1, "
                      f"got {FUSE_VERDICT!r}")
+
+# K-step DMA latency hiding in the packed containment kernel: "auto"
+# (default) replaces the "arbitrary"-dimension double buffering of the K
+# grid with an explicit pltpu.emit_pipeline inner loop — operand DMAs are
+# issued by a manual pipeline that overlaps the previous chunk's MXU pass —
+# wherever the probe (ops/pallas_kernels.emit_pipeline_supported) shows the
+# API actually traces and runs on this backend.  The probe fails closed off
+# TPU (emit_pipeline asserts the TPU backend even under interpret=True), so
+# the CPU proxy keeps the PR-6 grid and its wall clock cannot regress.
+# RDFIND_EMIT_PIPELINE=0 pins the PR-6 K-grid double buffering; =1 requests
+# the pipelined kernel but still falls back (byte-identical) where the
+# probe fails — force can select only paths that exist.
+EMIT_PIPELINE = os.environ.get("RDFIND_EMIT_PIPELINE", "auto")
+if EMIT_PIPELINE not in ("auto", "0", "1"):
+    raise ValueError(f"RDFIND_EMIT_PIPELINE must be auto, 0 or 1, "
+                     f"got {EMIT_PIPELINE!r}")
 
 # Sub-tile sparsity skipping: per-(dep-tile x line-block) membership
 # popcounts drive the dense sweep schedule — dep tiles whose captures occur
@@ -185,27 +203,113 @@ def int4_elements_native() -> bool:
     return _int4_pays_off()
 
 
+@functools.lru_cache(maxsize=1)
+def int2_matmul_supported() -> bool:
+    """One-time runtime probe: does this backend lower an int2 x int2 matmul
+    with int32 accumulation?  Same discipline as int4_matmul_supported —
+    XLA CPU rejects custom sub-byte element types outright, so the crumb
+    mode emulates with int8 elements there (the widened WK grid is kept
+    either way, which is what the CPU parity matrix exercises)."""
+    if not hasattr(jnp, "int2"):
+        return False
+    try:
+        a = jnp.ones((8, 8), jnp.int2)
+        out = jax.lax.dot_general(a, a, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        return bool(jax.device_get(out)[0, 0] == 8)
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=1)
+def _int2_pays_off() -> bool:
+    """Whether "auto" plane bits resolve to 2: the int2 matmul must lower
+    AND the backend must have a hardware sub-byte MXU path worth taking —
+    the same backend gate as _int8_pays_off / _int4_pays_off."""
+    return jax.default_backend() == "tpu" and int2_matmul_supported()
+
+
+def int2_elements_native() -> bool:
+    """Whether jnp.int2 planes can actually live in VMEM on this backend.
+    Where they cannot, the crumb-WK mode keeps its quadrupled K-step grid
+    but unpacks to int8 elements — bit-identical, differential-testable."""
+    return _int2_pays_off()
+
+
 def resolved_plane_bits() -> int:
-    """Plane width of the packed containment kernel (4 or 8).
+    """Plane width of the packed containment kernel (2, 4 or 8).
 
     Reads PLANE_BITS at call time (tests monkeypatch the module attribute);
-    only the backend probe behind "auto" is cached.  Only meaningful when
+    only the backend probes behind "auto" are cached.  Only meaningful when
     the resolved cooc dtype is int8 — the bf16 fallback keeps 16-bit
     planes."""
     if PLANE_BITS != "auto":
         return int(PLANE_BITS)
+    if _int2_pays_off():
+        return 2
     return 4 if _int4_pays_off() else 8
 
 
 def resolved_kernel_dtype() -> str:
     """Unpack dtype of the packed Pallas containment kernel: the resolved
-    cooc dtype, narrowed to "int4" when the nibble-plane mode is in effect.
-    The jnp planes fallback keeps the plain cooc dtype (XLA has no portable
-    sub-byte contraction); both are exact and bit-identical."""
+    cooc dtype, narrowed to "int4"/"int2" when a sub-byte plane mode is in
+    effect.  The jnp planes fallback keeps the plain cooc dtype (XLA has no
+    portable sub-byte contraction); all modes are exact and bit-identical."""
     dtype = resolved_cooc_dtype()
-    if dtype == "int8" and resolved_plane_bits() == 4:
-        return "int4"
+    if dtype == "int8":
+        bits = resolved_plane_bits()
+        if bits == 2:
+            return "int2"
+        if bits == 4:
+            return "int4"
     return dtype
+
+
+def emit_pipeline_enabled() -> bool:
+    """Whether the packed containment kernel runs its explicit
+    pltpu.emit_pipeline K-loop instead of the PR-6 "arbitrary"-dimension
+    double buffering.  Reads EMIT_PIPELINE at call time (tests monkeypatch
+    the module attribute); the availability probe behind both "auto" and
+    the =1 force is cached.  Force still falls back where the probe fails
+    (emit_pipeline cannot trace off TPU, even interpreted) — outputs are
+    bit-identical either way, so the fallback is silent by design."""
+    if EMIT_PIPELINE == "0":
+        return False
+    from . import pallas_kernels
+
+    if not pallas_kernels.emit_pipeline_supported():
+        return False
+    if EMIT_PIPELINE == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def resolution_report() -> dict:
+    """The single describe() surface for every kernel-mode decision: raw
+    knob values next to what they resolved to (probes included), so
+    plane-bits / emit-pipeline / fuse / block-skip choices are visible in
+    one struct instead of three scattered gauges.  Published through the
+    metrics shims into run stats ("kernel_resolution") and rendered on the
+    shared --debug dense-plan line."""
+    from . import pallas_kernels
+
+    kernel_dtype = resolved_kernel_dtype()
+    return {
+        "cooc_dtype": resolved_cooc_dtype(),
+        "plane_bits": resolved_plane_bits(),
+        "kernel_dtype": kernel_dtype,
+        "plane_elem": pallas_kernels._plane_elem(kernel_dtype),
+        "emit_pipeline": emit_pipeline_enabled(),
+        "fuse_verdict": fuse_verdict_enabled(),
+        "block_skip": block_skip_enabled(),
+        "knobs": {
+            "RDFIND_COOC_DTYPE": COOC_DTYPE,
+            "RDFIND_PLANE_BITS": PLANE_BITS,
+            "RDFIND_EMIT_PIPELINE": EMIT_PIPELINE,
+            "RDFIND_FUSE_VERDICT": FUSE_VERDICT,
+            "RDFIND_BLOCK_SKIP": BLOCK_SKIP,
+        },
+    }
 
 
 def fuse_verdict_enabled() -> bool:

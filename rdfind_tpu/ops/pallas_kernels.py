@@ -29,6 +29,14 @@ MXU-rate notes (the round-6 rework):
     exact); backends without native int4 elements run the same widened-WK
     grid with int8 elements (bit-identical — the emulation the CPU parity
     tests exercise, since XLA CPU rejects sub-byte conversion outright).
+  * **int2 crumb planes** (`unpack_dtype="int2"`, RDFIND_PLANE_BITS=2, the
+    round-12 rung) halve once more: WK 1024 words = 32768 contraction lanes
+    per K step — four times int8's K-dim per MXU pass at the same VMEM
+    budget.  The exactness argument is width-independent: planes are 0/1 in
+    any element type and the accumulator stays int32, so a crumb holding
+    {0, 1} loses nothing against a byte holding {0, 1}.  Backends without
+    native int2 elements keep the quadrupled-WK grid with int8 elements,
+    exactly like the int4 emulation.
   * the **dep-tile unpack is hoisted out of the ref-tile grid dimension**:
     the ref (j) dimension revisits the same dep tile nj times, so the shifted
     planes are computed once at j == 0 into a persistent VMEM scratch and
@@ -42,6 +50,17 @@ MXU-rate notes (the round-6 rework):
     operand DMAs against the matmul of the previous chunk; the ref-tile (j)
     dimension is also "arbitrary" because the hoisted scratch carries state
     across it.
+  * **explicit K-step pipelining** (RDFIND_EMIT_PIPELINE, the round-12
+    rung): where `pltpu.emit_pipeline` is available (probed — it asserts
+    the TPU backend even under interpret=True, so the probe fails closed
+    on CPU), the K grid dimension moves into a manual inner pipeline: the
+    ref-side packed chunks stay HBM-resident (memory_space=ANY) and the
+    pipeline's own double-buffered DMAs overlap each chunk's copy-in with
+    the previous chunk's MXU pass, replacing Mosaic's implicit
+    "arbitrary"-dimension buffering with an explicitly scheduled one.  The
+    dep tile is fetched full-width once per (i, j) step and its planes
+    hoisted exactly as in the grid variant, so outputs are bit-identical
+    across emit on/off — the parity matrix asserts it.
 
 Layout notes (see /opt/skills/guides/pallas_guide.md): Mosaic cannot slice the
 lane dimension at non-128-aligned offsets, so the unpack avoids slicing
@@ -73,14 +92,16 @@ TILE_R = 128
 # per int8 operand tile) — larger K-step DMAs, longer MXU contractions.
 # int4 nibble planes (RDFIND_PLANE_BITS=4) halve the element again: 512
 # words = 16384 contraction lanes per step, so each MXU pass covers twice
-# int8's K-dim at the same VMEM budget.  Exactness is untouched — planes
-# are 0/1 in every width and accumulation stays int32.
-WK_MAX = {"int4": 512, "int8": 256, "bf16": 128}
+# int8's K-dim at the same VMEM budget; int2 crumb planes
+# (RDFIND_PLANE_BITS=2) halve once more to 1024 words = 32768 lanes.
+# Exactness is untouched — planes are 0/1 in every width and accumulation
+# stays int32.
+WK_MAX = {"int2": 1024, "int4": 512, "int8": 256, "bf16": 128}
 # Bits per unpacked plane element, keyed by unpack dtype (the VMEM/hoist
-# budget arithmetic; int4 planes may fall back to int8 *elements* on
+# budget arithmetic; int4/int2 planes may fall back to int8 *elements* on
 # backends without native sub-byte support — see _plane_elem — but keep
 # their widened WK grid either way).
-PLANE_ELEM_BITS = {"int4": 4, "int8": 8, "bf16": 16}
+PLANE_ELEM_BITS = {"int2": 2, "int4": 4, "int8": 8, "bf16": 16}
 # VMEM budget for the hoisted full-width dep planes (TILE_D x bits x elem
 # bytes).  4 MB covers bits <= 65536 in int4 / 32768 in int8 / 16384 in
 # bf16 and leaves the double-buffered operand tiles + accumulator well
@@ -128,23 +149,28 @@ def _default_unpack_dtype() -> str:
 def _plane_elem(dtype: str) -> str:
     """Resolved element type the planes are actually stored/contracted in.
 
-    "int4" planes use native jnp.int4 elements only where the backend's
-    int4 matmul lowers (cooc.int4_elements_native probe); elsewhere the
-    nibble mode keeps its doubled-WK grid but stores int8 elements — the
-    arithmetic is identical (0/1 planes, int32 accumulation), so outputs
-    are bit-identical and the mode stays differential-testable on CPU,
-    whose XLA rejects sub-byte conversions outright.  The result is a
-    STATIC jit key alongside unpack_dtype: a probe flip must retrace."""
-    if dtype == "int4":
-        from . import cooc
+    "int4"/"int2" planes use native sub-byte jnp elements only where the
+    backend's matching matmul lowers (cooc.int4_elements_native /
+    int2_elements_native probes); elsewhere the sub-byte mode keeps its
+    widened-WK grid but stores int8 elements — the arithmetic is identical
+    (0/1 planes, int32 accumulation), so outputs are bit-identical and
+    every mode stays differential-testable on CPU, whose XLA rejects
+    sub-byte conversions outright.  The result is a STATIC jit key
+    alongside unpack_dtype: a probe flip must retrace."""
+    from . import cooc
 
+    if dtype == "int4":
         return "int4" if cooc.int4_elements_native() else "int8"
+    if dtype == "int2":
+        return "int2" if cooc.int2_elements_native() else "int8"
     return dtype
 
 
 _PLANE_JNP = {"bf16": jnp.bfloat16, "int8": jnp.int8}
 if hasattr(jnp, "int4"):
     _PLANE_JNP["int4"] = jnp.int4
+if hasattr(jnp, "int2"):
+    _PLANE_JNP["int2"] = jnp.int2
 
 
 def _repeat32(x):
@@ -220,9 +246,97 @@ def _contains_kernel(s_ref, r_ref, popc_ref, out_ref, s_plane_ref, acc_ref, *,
         out_ref[:] = (acc_ref[:].astype(jnp.int32) == popc_ref[:]).astype(jnp.uint8)
 
 
+@functools.lru_cache(maxsize=1)
+def emit_pipeline_supported() -> bool:
+    """Whether pltpu.emit_pipeline actually traces AND runs here.
+
+    hasattr alone is not a probe: the API exists on every recent jax but
+    asserts the TPU backend at trace time even under interpret=True, so on
+    the CPU proxy a hasattr gate would select a kernel that cannot compile.
+    Instead a minimal two-step accumulation pipeline is run end to end
+    (probe-before-assume, like _repeat_is_tile); any failure — missing
+    API, backend assert, lowering error — falls back to the PR-6
+    "arbitrary"-dimension K grid, which is bit-identical."""
+    if not hasattr(pltpu, "emit_pipeline"):
+        return False
+    try:
+        def kern(x_hbm, o_ref, acc_ref):
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+            def body(x_ref):
+                acc_ref[:] += x_ref[:]
+
+            pltpu.emit_pipeline(
+                body, grid=(2,),
+                in_specs=[pl.BlockSpec((8, 128), lambda k: (k, 0))])(x_hbm)
+            o_ref[:] = acc_ref[:]
+
+        with jax.ensure_compile_time_eval():
+            out = pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
+            )(jnp.ones((16, 128), jnp.float32))
+            return bool(np.asarray(out)[0, 0] == 2.0)
+    except Exception:
+        return False
+
+
+def _contains_kernel_emit(s_ref, r_hbm, popc_ref, out_ref, s_plane_ref,
+                          acc_ref, step_ref, *, nk: int, wk: int, plane_dt,
+                          tile_order: bool, hoist: bool, acc_dt):
+    """The emit-pipeline variant of _contains_kernel: outer grid (i, j)
+    only; the K dimension runs as an explicit pltpu.emit_pipeline whose
+    double-buffered DMAs stream the packed ref chunks out of HBM
+    (memory_space=ANY) while the previous chunk's MXU pass runs.  The dep
+    tile arrives full-width in VMEM once per (i, j) step; its planes are
+    hoisted into scratch at j == 0 exactly as in the grid variant (chunked
+    unpack — the uint32 repeat intermediate must stay one chunk wide).
+    step_ref (SMEM) tracks the inner step because the pipeline body runs
+    under its own grid env, where pl.program_id no longer names the outer
+    axes."""
+    j = pl.program_id(1)
+    wk32 = wk * 32
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    step_ref[0] = 0
+
+    if hoist:
+        @pl.when(j == 0)
+        def _fill():
+            for kk in range(nk):  # static unroll: one chunk-wide unpack each
+                s_plane_ref[:, kk * wk32:(kk + 1) * wk32] = _unpack_tile(
+                    s_ref[:, kk * wk:(kk + 1) * wk], plane_dt, tile_order)
+
+    def body(r_ref):
+        k = step_ref[0]
+        if hoist:
+            # nk == 1 keeps the chunk offset static; otherwise wk32 is a
+            # 128-multiple (wk == WK_MAX there), so the dynamic lane offset
+            # stays Mosaic-aligned — same contract as the grid variant.
+            chunk = (slice(0, wk32) if nk == 1 else pl.ds(k * wk32, wk32))
+            s_b = s_plane_ref[:, chunk]
+        else:
+            pchunk = (slice(0, wk) if nk == 1 else pl.ds(k * wk, wk))
+            s_b = _unpack_tile(s_ref[:, pchunk], plane_dt, tile_order)
+        r_b = _unpack_tile(r_ref[:], plane_dt, tile_order)
+        acc_ref[:] += jax.lax.dot_general(
+            s_b, r_b, (((1,), (1,)), ((), ())),
+            preferred_element_type=acc_dt)
+        step_ref[0] = k + 1
+
+    pltpu.emit_pipeline(
+        body, grid=(nk,),
+        in_specs=[pl.BlockSpec((popc_ref.shape[1], wk),
+                               lambda k: (j, k))])(r_hbm)
+    out_ref[:] = (acc_ref[:].astype(jnp.int32) == popc_ref[:]).astype(jnp.uint8)
+
+
 def packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
                            interpret: bool = False,
-                           unpack_dtype: str | None = None):
+                           unpack_dtype: str | None = None,
+                           emit_pipeline: bool | None = None):
     """(D, R) uint8 containment matrix from packed uint32 rows.
 
     sketch_packed: (D, W) packed dep sketches; ref_packed: (R, W) packed ref bit
@@ -231,43 +345,90 @@ def packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
     power of two >= 32, as ops/sketch.py enforces).  `interpret=True` runs the
     kernel in the Pallas interpreter (CPU tests).  `unpack_dtype` selects the
     in-register plane type ("int8" wherever int8 matmul lowers — the default —
-    else "bf16"); both are exact and bit-identical.
+    else "bf16"); every mode is exact and bit-identical.  `emit_pipeline`
+    selects the explicit K-step pipeline (default: the resolved
+    RDFIND_EMIT_PIPELINE policy); where the probe says the API cannot run,
+    the request silently degrades to the grid variant — same outputs.
     """
     if unpack_dtype is None:
         unpack_dtype = _default_unpack_dtype()
     if unpack_dtype not in WK_MAX:
-        raise ValueError(f"unpack_dtype must be int4, int8 or bf16, "
+        raise ValueError(f"unpack_dtype must be int2, int4, int8 or bf16, "
                          f"got {unpack_dtype!r}")
-    # The pltpu.repeat lane-order probe keys the jit cache, and so does the
-    # resolved plane element type (PR-2's static-key discipline extended to
-    # plane width): a monkeypatched or version-dependent flip must retrace
-    # the kernel, not reuse the other order's program.
+    if emit_pipeline is None:
+        from . import cooc
+
+        emit_pipeline = cooc.emit_pipeline_enabled()
+    # The pltpu.repeat lane-order probe keys the jit cache, and so do the
+    # resolved plane element type and the emit-pipeline resolution (PR-2's
+    # static-key discipline extended to plane width and K-step schedule): a
+    # monkeypatched or version-dependent flip must retrace the kernel, not
+    # reuse the other mode's program.
     return _packed_contains_matrix(sketch_packed, ref_packed, ref_popc,
                                    interpret=interpret,
                                    unpack_dtype=unpack_dtype,
                                    plane_elem=_plane_elem(unpack_dtype),
-                                   tile_order=_repeat_is_tile())
+                                   tile_order=_repeat_is_tile(),
+                                   emit=bool(emit_pipeline)
+                                   and emit_pipeline_supported())
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "unpack_dtype",
-                                             "plane_elem", "tile_order"))
+                                             "plane_elem", "tile_order",
+                                             "emit"))
 def _packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
                             interpret: bool, unpack_dtype: str,
-                            plane_elem: str, tile_order: bool):
+                            plane_elem: str, tile_order: bool,
+                            emit: bool = False):
     d, w = sketch_packed.shape
     r = ref_packed.shape[0]
     wk = min(w, WK_MAX[unpack_dtype])
     if d % TILE_D or r % TILE_R or w % wk:
         raise ValueError(f"shapes must be tile-aligned, got D={d} R={r} W={w}")
     nk = w // wk
-    grid = (d // TILE_D, r // TILE_R, nk)
-    # Budget arithmetic follows the unpack *mode* (int4 plans for nibble
-    # VMEM even when elements emulate as int8 — the WK grid must not depend
-    # on the emulation fallback or the two would compile different K steps).
+    # Budget arithmetic follows the unpack *mode* (int4/int2 plan for
+    # sub-byte VMEM even when elements emulate as int8 — the WK grid must
+    # not depend on the emulation fallback or the two would compile
+    # different K steps).
     elem_bits = PLANE_ELEM_BITS[unpack_dtype]
     plane_dt = _PLANE_JNP.get(plane_elem, jnp.int8)
     acc_dt = jnp.float32 if unpack_dtype == "bf16" else jnp.int32
     hoist = TILE_D * w * 32 * elem_bits // 8 <= HOIST_PLANE_BUDGET
+    if emit:
+        kernel = functools.partial(_contains_kernel_emit, nk=nk, wk=wk,
+                                   plane_dt=plane_dt, tile_order=tile_order,
+                                   hoist=hoist, acc_dt=acc_dt)
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((d, r), jnp.uint8),
+            grid=(d // TILE_D, r // TILE_R),
+            in_specs=[
+                # Dep tile full-width in VMEM (packed words are 4 bytes x W
+                # <= 8 KB per row — far under the plane scratch itself);
+                # ref side stays HBM-resident, chunks DMAed by the inner
+                # pipeline.
+                pl.BlockSpec((TILE_D, w), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec((1, TILE_R), lambda i, j: (0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((TILE_D, TILE_R), lambda i, j: (i, j),
+                                   memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((TILE_D, (w if hoist else wk) * 32), plane_dt),
+                pltpu.VMEM((TILE_D, TILE_R), acc_dt),
+                pltpu.SMEM((1,), jnp.int32),
+            ],
+            # j is "arbitrary": the hoisted dep planes carry state across
+            # the ref tiles; the K dimension lives inside the kernel now.
+            compiler_params=getattr(pltpu, "CompilerParams",
+                                    getattr(pltpu, "TPUCompilerParams",
+                                            None))(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(sketch_packed, ref_packed, ref_popc.reshape(1, r))
+    grid = (d // TILE_D, r // TILE_R, nk)
     kernel = functools.partial(_contains_kernel, nk=nk, wk=wk,
                                plane_dt=plane_dt, tile_order=tile_order,
                                hoist=hoist, acc_dt=acc_dt)
@@ -323,12 +484,15 @@ def _packed_contains_matrix(sketch_packed, ref_packed, ref_popc, *,
 # block-id schedule, so all-zero (dep-tile x line-block) pairs — per-block
 # membership popcounts, the join-line skew record — are never fetched, and
 # the j/k grid dims are "arbitrary" so Mosaic double-buffers the K-step
-# operand DMAs against the previous block's matmul (the same latency-hiding
-# contract the containment kernel relies on; pltpu.emit_pipeline would hand
-# the same overlap to an inner manual pipeline, but the scalar-prefetch grid
-# is the variant every shipped jax in this stack supports — probed, not
-# assumed, like the pltpu.repeat shim).  Padded schedule entries fetch block
-# 0 and are compute-guarded by the prefetched real-block count.
+# operand DMAs against the previous block's matmul.  Of the two explicit
+# K-step mechanisms the roofline plan names, this kernel rides the
+# scalar-prefetched grid (its K schedule is data-dependent — the block-id
+# list IS the prefetched scalar, already an explicitly scheduled,
+# double-buffered K loop), while the containment kernel
+# above carries the pltpu.emit_pipeline variant (RDFIND_EMIT_PIPELINE;
+# probed, not assumed, like the pltpu.repeat shim).  Padded schedule
+# entries fetch block 0 and are compute-guarded by the prefetched
+# real-block count.
 # ---------------------------------------------------------------------------
 
 CIND_BLOCK_D = 128

@@ -165,10 +165,11 @@ def _contract_dtype() -> str:
 
 
 def _kernel_dtype() -> str:
-    """Unpack dtype of the packed Pallas kernel: narrows to int4 nibble
-    planes under the plane-bits policy (RDFIND_PLANE_BITS) — each MXU pass
-    then covers twice the K-dim — while the jnp fallback keeps the plain
-    cooc dtype (XLA has no portable sub-byte contraction).  Both exact."""
+    """Unpack dtype of the packed Pallas kernel: narrows to int4 nibble or
+    int2 crumb planes under the plane-bits policy (RDFIND_PLANE_BITS) —
+    each MXU pass then covers 2x/4x the K-dim — while the jnp fallback
+    keeps the plain cooc dtype (XLA has no portable sub-byte contraction).
+    All modes exact."""
     from . import cooc
 
     return cooc.resolved_kernel_dtype()
@@ -324,8 +325,21 @@ def kernel_selfcheck(n_rows: int = 1024, n_bits: int = 4096,
     out_pallas = run("pallas", interpret=not on_tpu)
     parity = bool(jnp.array_equal(out_jnp, out_pallas))
 
+    from . import cooc
     result = {"parity": parity, "n_rows": n_rows, "bits": n_bits,
-              "backend": backend}
+              "backend": backend,
+              # The resolved kernel mode this selfcheck actually ran — the
+              # provenance the bench kernel-mode rows and tpu_watch capture
+              # key on (one row per knob set is meaningless without it).
+              "kernel_dtype": _kernel_dtype(),
+              "plane_bits": cooc.resolved_plane_bits(),
+              "emit_pipeline": cooc.emit_pipeline_enabled()}
+    # Content hash of the kernel output: lets bench rows taken under
+    # DIFFERENT knob sets (plane bits, emit_pipeline) assert bit-identity
+    # across rows, not just within-row jnp-vs-pallas parity.
+    import hashlib
+    result["out_hash"] = hashlib.sha1(
+        np.asarray(out_pallas).tobytes()).hexdigest()[:16]
     # HBM traffic model of the packed kernel (ops/pallas_kernels.py grid):
     # each packed operand tile is re-read once per opposite-side tile, plus
     # the uint8 output write — the measured-bandwidth denominator for the

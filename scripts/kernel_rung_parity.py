@@ -1,0 +1,100 @@
+"""Rung-3 kernel parity gate: planes {8,4,2} x emit_pipeline {off,on}.
+
+Tiny planted workload on the CPU proxy, every knob combination asserted
+bit-identical — both for the packed containment kernel (interpreted Pallas
+vs jnp planes, plus cross-combination output hashes) and for the dense
+CIND sweep (fused and materialized discover_pairs_dense).  Off-TPU the
+emit=1 rows exercise the probe-refusal fallback path, which is exactly the
+contract under test: forcing a knob must never change results, only
+schedules.  scripts/verify.sh runs this between the tier-1 suite and the
+tiny bench; VERIFY_SKIP_KERNEL_RUNGS=1 opts out.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+PLANES = ("8", "4", "2")
+EMITS = ("0", "1")
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    from rdfind_tpu.ops import cooc, sketch
+
+    failures = []
+
+    # --- Packed containment kernel: per-combo jnp parity + one shared hash.
+    hashes = {}
+    for pb in PLANES:
+        for em in EMITS:
+            cooc.PLANE_BITS, cooc.EMIT_PIPELINE = pb, em
+            r = sketch.kernel_selfcheck(n_rows=128, n_bits=2048, repeats=1)
+            tag = f"planes{pb}/emit{em}"
+            if not r["parity"]:
+                failures.append(f"{tag}: pallas vs jnp parity FAILED")
+            hashes[tag] = r["out_hash"]
+    if len(set(hashes.values())) != 1:
+        failures.append(f"containment outputs differ across combos: {hashes}")
+
+    # --- Dense CIND sweep: planted membership, fused x materialized x the
+    # plane/emit grid, identical (dep, ref) pair sets everywhere.
+    rng = np.random.default_rng(3)
+    n_lines, num_caps = 300, 200
+    plan = cooc.dense_plan(n_lines, num_caps)
+    member = rng.random((plan.l_pad, plan.c_pad)) < 0.02
+    # Plant real containments (dep col k subset of ref col 100+k): random
+    # IID membership at this size admits none, and a gate that can only
+    # ever compare empty sets proves nothing.
+    for k in range(20):
+        member[:, 100 + k] |= member[:, k]
+    dt = jnp.int8 if plan.dtype == "int8" else jnp.bfloat16
+    m = jax.block_until_ready(jnp.asarray(member, dt))
+    dep_count = member.sum(axis=0).astype(np.int64)
+    cap_id = rng.integers(0, 1 << 20, plan.c_pad).astype(np.int64)
+
+    baseline = None
+    for pb in PLANES:
+        for em in EMITS:
+            for fv in ("0", "1"):
+                cooc.PLANE_BITS, cooc.EMIT_PIPELINE = pb, em
+                cooc.FUSE_VERDICT = fv
+                mode_plan = cooc.dense_plan(n_lines, num_caps)
+                d, r, _ = cooc.discover_pairs_dense(
+                    m, dep_count, cap_id, cap_id, cap_id, 3, num_caps,
+                    mode_plan.tile, starts=mode_plan.dep_tile_starts,
+                    plan=mode_plan)
+                pairs = set(zip(d.tolist(), r.tolist()))
+                tag = f"planes{pb}/emit{em}/fuse{fv}"
+                if baseline is None:
+                    baseline = pairs
+                    if not pairs:
+                        failures.append("planted workload produced 0 pairs "
+                                        "(gate is vacuous)")
+                elif pairs != baseline:
+                    failures.append(
+                        f"{tag}: dense pair set differs from baseline "
+                        f"({len(pairs)} vs {len(baseline)} pairs)")
+
+    if failures:
+        for f in failures:
+            print(f"kernel_rung_parity: {f}", file=sys.stderr)
+        return 1
+    print(f"kernel_rung_parity: OK — containment hash "
+          f"{next(iter(hashes.values()))} and {len(baseline)} dense pairs "
+          f"identical across {len(PLANES) * len(EMITS)} containment and "
+          f"{len(PLANES) * len(EMITS) * 2} dense combos")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

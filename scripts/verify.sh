@@ -57,6 +57,17 @@ elif ! JAX_PLATFORMS=cpu timeout -k 10 600 python scripts/console_smoke.py; then
     exit 1
 fi
 
+echo "== rung-3 kernel parity (planes {8,4,2} x emit on/off) =="
+# Every knob combination must produce bit-identical containment outputs and
+# dense CIND pair sets on a tiny planted workload — knobs move schedules,
+# never results.  VERIFY_SKIP_KERNEL_RUNGS=1 opts out.
+if [ "${VERIFY_SKIP_KERNEL_RUNGS:-0}" = "1" ]; then
+    echo "verify: kernel-rung parity skipped (VERIFY_SKIP_KERNEL_RUNGS=1)"
+elif ! JAX_PLATFORMS=cpu timeout -k 10 600 python scripts/kernel_rung_parity.py; then
+    echo "verify: kernel-rung parity FAILED" >&2
+    exit 1
+fi
+
 if [ "${VERIFY_SKIP_BENCH:-0}" = "1" ]; then
     echo "verify: tier-1 green; bench + sentinel skipped (VERIFY_SKIP_BENCH=1)"
     exit 0

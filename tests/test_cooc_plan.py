@@ -132,24 +132,32 @@ def test_strategies_invariant_to_dtype_and_schedule(monkeypatch, dtype,
 
 
 def test_plane_bits_resolution_policy(monkeypatch):
-    # Explicit pins are honored; "auto" narrows to 4 only where the int4
-    # MXU path pays off (TPU), mirroring the _int8_pays_off discipline —
-    # the CPU proxy stays on 8-bit planes and cannot regress.
+    # Explicit pins are honored; "auto" narrows to the NARROWEST sub-byte
+    # mode whose MXU path pays off (2, else 4, else 8), mirroring the
+    # _int8_pays_off discipline — the CPU proxy stays on 8-bit planes and
+    # cannot regress.
     monkeypatch.setattr(cooc, "PLANE_BITS", "8")
     assert cooc.resolved_plane_bits() == 8
     monkeypatch.setattr(cooc, "PLANE_BITS", "4")
     assert cooc.resolved_plane_bits() == 4
+    monkeypatch.setattr(cooc, "PLANE_BITS", "2")
+    assert cooc.resolved_plane_bits() == 2
     monkeypatch.setattr(cooc, "PLANE_BITS", "auto")
-    assert cooc.resolved_plane_bits() == (4 if cooc._int4_pays_off() else 8)
-    # The kernel dtype narrows to int4 only on int8 membership: the bf16
-    # fallback keeps its own planes.
+    assert cooc.resolved_plane_bits() == (
+        2 if cooc._int2_pays_off() else 4 if cooc._int4_pays_off() else 8)
+    # The kernel dtype narrows to int4/int2 only on int8 membership: the
+    # bf16 fallback keeps its own planes.
     monkeypatch.setattr(cooc, "COOC_DTYPE", "int8")
     monkeypatch.setattr(cooc, "PLANE_BITS", "4")
     assert cooc.resolved_kernel_dtype() == "int4"
+    monkeypatch.setattr(cooc, "PLANE_BITS", "2")
+    assert cooc.resolved_kernel_dtype() == "int2"
     monkeypatch.setattr(cooc, "PLANE_BITS", "8")
     assert cooc.resolved_kernel_dtype() == "int8"
     monkeypatch.setattr(cooc, "COOC_DTYPE", "bf16")
     monkeypatch.setattr(cooc, "PLANE_BITS", "4")
+    assert cooc.resolved_kernel_dtype() == "bf16"
+    monkeypatch.setattr(cooc, "PLANE_BITS", "2")
     assert cooc.resolved_kernel_dtype() == "bf16"
 
 

@@ -1,10 +1,13 @@
 """Pallas packed-containment kernel vs. the jnp planes formulation.
 
 Runs the kernel in interpreter mode (CPU); the lowered TPU path is exercised by
-bench runs on the real chip.  Parity is checked for BOTH unpack dtypes (int8 —
-the default wherever int8 matmul lowers — and the bf16 fallback) under BOTH
-pltpu.repeat lane-order branches, with the matching repeat semantics emulated
-via monkeypatch so each shift formula is exercised on every jax version.
+bench runs on the real chip.  Parity is checked for ALL unpack dtypes (int8 —
+the default wherever int8 matmul lowers — the int4 nibble and int2 crumb
+sub-byte modes, and the bf16 fallback) under BOTH pltpu.repeat lane-order
+branches, with the matching repeat semantics emulated via monkeypatch so each
+shift formula is exercised on every jax version, and across the emit_pipeline
+knob (off-TPU its =True rows run the probe-refusal fallback — the contract
+that forcing a knob never changes outputs).
 """
 
 import numpy as np
@@ -38,7 +41,7 @@ def force_repeat_order(monkeypatch, tile_order: bool):
 
 
 @pytest.mark.parametrize("tile_order", [True, False])
-@pytest.mark.parametrize("unpack_dtype", ["int4", "int8", "bf16"])
+@pytest.mark.parametrize("unpack_dtype", ["int2", "int4", "int8", "bf16"])
 @pytest.mark.parametrize("seed,bits", [(0, BITS), (1, BITS), (0, 16384),
                                        (2, 32768)])
 def test_packed_kernel_matches_jnp(monkeypatch, seed, bits, unpack_dtype,
@@ -47,10 +50,12 @@ def test_packed_kernel_matches_jnp(monkeypatch, seed, bits, unpack_dtype,
     # the K-grid accumulation (scratch init at k==0, finalize at k==nk-1)
     # with nk >= 2 plus the hoisted dep-plane chunk writes at dynamic K
     # offsets; bits=32768 (W=1024) pushes past int4's doubled WK=512 too,
-    # so the nibble mode's widened K step gets a genuine nk=2 grid.  On
-    # backends without native int4 elements the nibble mode runs its
-    # doubled-WK grid with int8 elements — the documented emulation, same
-    # arithmetic, so parity must hold everywhere.
+    # so the nibble mode's widened K step gets a genuine nk=2 grid (and
+    # exactly fills int2's quadrupled WK=1024 — its nk=2 case is
+    # test_packed_kernel_int2_multi_k below).  On backends without native
+    # int4/int2 elements the sub-byte modes run their widened-WK grids
+    # with int8 elements — the documented emulation, same arithmetic, so
+    # parity must hold everywhere.
     force_repeat_order(monkeypatch, tile_order)
     rng = np.random.default_rng(seed)
     d, r = 128, 128
@@ -66,7 +71,48 @@ def test_packed_kernel_matches_jnp(monkeypatch, seed, bits, unpack_dtype,
     np.testing.assert_array_equal(got.astype(bool), want)
 
 
-@pytest.mark.parametrize("unpack_dtype", ["int4", "int8", "bf16"])
+@pytest.mark.parametrize("tile_order", [True, False])
+def test_packed_kernel_int2_multi_k(monkeypatch, tile_order):
+    # bits=65536 -> W=2048 words: past even int2's quadrupled WK=1024, so
+    # the crumb mode runs a genuine nk=2 K-grid (accumulating scratch +
+    # dynamic-offset hoisted chunks) rather than a single widened step.
+    force_repeat_order(monkeypatch, tile_order)
+    rng = np.random.default_rng(4)
+    bits, d, r = 65536, 128, 128
+    sketches = random_sketches(rng, d, bits)
+    ref_ids = jnp.asarray(rng.integers(0, 500, size=r, dtype=np.int32))
+    valid = jnp.ones(r, bool)
+    want = np.asarray(sketch._contains_matrix_jnp(
+        jnp.asarray(sketches), ref_ids, valid, bits=bits, num_hashes=K))
+    ref_packed, popc = sketch.pack_ref_bits(ref_ids, bits=bits, num_hashes=K)
+    got = np.asarray(pallas_kernels.packed_contains_matrix(
+        jnp.asarray(sketches), ref_packed, popc, interpret=True,
+        unpack_dtype="int2"))
+    np.testing.assert_array_equal(got.astype(bool), want)
+
+
+@pytest.mark.parametrize("unpack_dtype", ["int2", "int4", "int8", "bf16"])
+@pytest.mark.parametrize("emit", [None, False, True])
+def test_packed_kernel_emit_knob_is_output_invariant(monkeypatch,
+                                                     unpack_dtype, emit):
+    # emit_pipeline=True off-TPU exercises the probe-refusal fallback (the
+    # emit kernel cannot trace on CPU, even interpreted): all three knob
+    # values must be bit-identical, and None must follow the resolver.
+    rng = np.random.default_rng(6)
+    d, r = 128, 128
+    sketches = random_sketches(rng, d, BITS)
+    ref_ids = jnp.asarray(rng.integers(0, 500, size=r, dtype=np.int32))
+    valid = jnp.ones(r, bool)
+    want = np.asarray(sketch._contains_matrix_jnp(
+        jnp.asarray(sketches), ref_ids, valid, bits=BITS, num_hashes=K))
+    ref_packed, popc = sketch.pack_ref_bits(ref_ids, bits=BITS, num_hashes=K)
+    got = np.asarray(pallas_kernels.packed_contains_matrix(
+        jnp.asarray(sketches), ref_packed, popc, interpret=True,
+        unpack_dtype=unpack_dtype, emit_pipeline=emit))
+    np.testing.assert_array_equal(got.astype(bool), want)
+
+
+@pytest.mark.parametrize("unpack_dtype", ["int2", "int4", "int8", "bf16"])
 def test_packed_kernel_multi_tile_hoist(monkeypatch, unpack_dtype):
     # Multiple dep AND ref tiles: the hoisted dep-plane scratch is filled at
     # j == 0 and re-read for every later ref tile, so any staleness across

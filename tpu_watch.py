@@ -6,9 +6,13 @@ committed).  The axon tunnel admits one client at a time and can wedge
 indefinitely after a holder is killed; probing in a killable subprocess is
 the only reliable verdict (see bench.py:_probe_tpu_subprocess).
 
-Loop: probe -> on success run `bench.py` (headline) and `bench_matrix.py`
-(configs 1-2 x strategies 0/1/2/3), append rows to BENCH_TPU_MATRIX.jsonl,
-write the headline line to BENCH_TPU_HEADLINE.json, then exit.  On failure
+Loop: probe -> on success run `bench.py` in kernel-modes-only mode (the
+fast rung-3 plane-bits x emit_pipeline grid -> BENCH_TPU_KERNEL_MODES.json
++ provenance-keyed BENCH_HISTORY.jsonl rows, captured FIRST so a re-wedge
+mid-headline loses nothing), then `bench.py` (headline) and
+`bench_matrix.py` (configs 1-2 x strategies 0/1/2/3), append rows to
+BENCH_TPU_MATRIX.jsonl, write the headline line to
+BENCH_TPU_HEADLINE.json, then exit.  On failure
 sleep and retry until --deadline-h expires or a `tpu_watch.stop` file
 appears next to this script.
 
@@ -101,6 +105,28 @@ def run_benches() -> bool:
     """
     ok = True
     env = dict(os.environ)
+    # Rung-3 kernel-mode grid first (planes {8,4,2} x emit on/off x fused):
+    # minutes, not the headline's half hour — so a tunnel that wedges again
+    # mid-headline still leaves the kernel rows in BENCH_HISTORY.jsonl
+    # (bench.py appends them provenance-keyed itself; the artifact file
+    # here is the human-readable mirror).
+    log("running bench.py (rung-3 kernel modes)...")
+    try:
+        r = subprocess.run([sys.executable, "bench.py"], capture_output=True,
+                           text=True, timeout=1800, cwd=REPO,
+                           env=dict(env, BENCH_KERNEL_MODES_ONLY="1"))
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+        log(f"bench.py kernel modes rc={r.returncode}: {line[:200]}")
+        if r.returncode == 0 and is_tpu_bench_line(line):
+            with open(os.path.join(REPO,
+                                   "BENCH_TPU_KERNEL_MODES.json"), "w") as f:
+                f.write(line + "\n")
+        else:
+            ok = False
+    except subprocess.TimeoutExpired:
+        log("bench.py kernel modes timed out (1800s)")
+        ok = False
+
     log("running bench.py (headline)...")
     try:
         r = subprocess.run([sys.executable, "bench.py"], capture_output=True,
