@@ -164,6 +164,7 @@ _SEED_GIANT = 5     # giant-line dependent ownership
 _SEED_PASS = 7      # dep-slice selection for bounded-memory pair passes
 _SEED_UNARY = 11    # +f, f in 0..2: frequency count exchanges
 _SEED_BINARY = 17   # +k, k in 0..2
+_SEED_HA = 23       # count-min pair keys for the sharded half-approx rounds
 
 
 def _freq_key_sets(triples):
@@ -545,32 +546,21 @@ def _captures_step(jv, code, v1, v2, n_rows, *, mesh, cap_exchange_b,
 # ---------------------------------------------------------------------------
 
 
-def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
-                cap_exchange_c, cap_giant, cap_giant_pairs,
-                skew=DEFAULT_SKEW, pass_idx=None, n_pass=None,
-                cap_exchange_c_dcn=0, hier=None, dcn_chunks=1):
-    """Skew-aware masked pair counting over value-sorted line rows.
+def _emit_local_pairs(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
+                      cap_giant, cap_giant_pairs, skew=DEFAULT_SKEW,
+                      pass_idx=None, n_pass=None):
+    """Pair emission + device-local pre-count of one dep-slice pass.
 
-    Emits all ordered co-occurrence pairs whose dependent row is dep-flagged and
-    partner row is ref-flagged (AllAtOnce passes all-valid flags; SmallToLarge
-    passes the level's candidate flags), splitting oversized lines across the
-    mesh, then routes pair partials to the dependent capture's owner (seed 2)
-    and merges counts there.
+    The first half of the pair phase: skew stats, giant-line split/gather,
+    masked pair emission, and the local masked_unique pre-count — everything
+    BEFORE any cross-device pair exchange.  This is also the sharded
+    two-round's "bounded explicit window per device": the deduped
+    (pair key, partial count) rows, bounded by cap_pairs/cap_giant_pairs,
+    that the round-1 count-min build folds into a partial table without the
+    pairs ever leaving the device.
 
-    pass_idx/n_pass (traced int32 scalars) select one dep-slice PASS: only
-    rows whose capture hashes to pass_idx (mod n_pass) emit pairs, so pair
-    buffers, the exchange, and the merge all shrink by ~n_pass while the
-    resident join lines are reread in place.  Slices partition the dependent
-    captures, so per-pass outputs concatenate with no cross-pass merge.
-    This is the bounded-memory analog of the reference's windowed merge
-    under heap pressure (BulkMergeDependencies.scala:96-104) — multi-pass
-    streaming over resident data instead of Flink's disk spill.  Emission
-    masking (ops/pairs.emit_pair_indices `emit`) means non-emitting rows
-    take zero buffer slots; n_pairs_total counts EMITTED pairs.
-
-    Returns (ucols(6), uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd),
-    n_giant_lines, n_giant_pairs, n_pairs_total).  ovf_cd is exchange C's
-    inter-host (DCN) hop overflow; always 0 on the flat path.
+    Returns (pcols(6), pvalid2, pcnt, (ovf_p, ovf_g, ovf_gp),
+    n_giant_lines, n_giant_pairs, n_pairs_total).
     """
     num_dev = jax.lax.psum(1, AXIS)
     my_idx = jax.lax.axis_index(AXIS)
@@ -658,6 +648,77 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
     pvalid_all = jnp.concatenate([pvalid, gpvalid])
     pcols, pvalid2, pinv, _ = segments.masked_unique(pair_cols, pvalid_all)
     pcnt = _masked_counts(pvalid_all, pinv, pcols[0].shape[0])
+    return (pcols, pvalid2, pcnt, (ovf_p, ovf_g, ovf_gp),
+            n_giant_lines, n_giant_pairs, n_pairs_total)
+
+
+def _ha_pair_keys(pcols):
+    """32-bit count-min key of one (dep capture, ref capture) pair row.
+
+    Pure function of the six key columns, so the same pair produces the same
+    key on every device and in every pass — the property the round-2 cut's
+    soundness argument leans on.
+    """
+    return hashing.hash_cols(pcols, seed=_SEED_HA).astype(jnp.int32)
+
+
+def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
+                cap_exchange_c, cap_giant, cap_giant_pairs,
+                skew=DEFAULT_SKEW, pass_idx=None, n_pass=None,
+                cap_exchange_c_dcn=0, hier=None, dcn_chunks=1, ha_cut=None):
+    """Skew-aware masked pair counting over value-sorted line rows.
+
+    Emits all ordered co-occurrence pairs whose dependent row is dep-flagged and
+    partner row is ref-flagged (AllAtOnce passes all-valid flags; SmallToLarge
+    passes the level's candidate flags), splitting oversized lines across the
+    mesh, then routes pair partials to the dependent capture's owner (seed 2)
+    and merges counts there.
+
+    pass_idx/n_pass (traced int32 scalars) select one dep-slice PASS: only
+    rows whose capture hashes to pass_idx (mod n_pass) emit pairs, so pair
+    buffers, the exchange, and the merge all shrink by ~n_pass while the
+    resident join lines are reread in place.  Slices partition the dependent
+    captures, so per-pass outputs concatenate with no cross-pass merge.
+    This is the bounded-memory analog of the reference's windowed merge
+    under heap pressure (BulkMergeDependencies.scala:96-104) — multi-pass
+    streaming over resident data instead of Flink's disk spill.  Emission
+    masking (ops/pairs.emit_pair_indices `emit`) means non-emitting rows
+    take zero buffer slots; n_pairs_total counts EMITTED pairs.
+
+    ha_cut, when set to (table, bits, num_hashes, thresh), applies the
+    round-2 candidate cut of the sharded half-approximate 1/1 BEFORE
+    exchange C: pair rows whose count-min upper bound falls below thresh are
+    dropped from the exchange.  Sound because the all-reduced table
+    upper-bounds min(true global cooc, cap) per pair and thresh is clamped
+    to min(min_support, cap) by the caller — a pair meeting min_support can
+    never estimate below thresh — and because the same pair hashes to the
+    same key on every device (`_ha_pair_keys`), so all of a pair's partial
+    rows survive or die together: no partial-sum corruption at the merge,
+    and cut pairs have true cooc < min_support, which the downstream CIND
+    test discards anyway.  Output is therefore bit-identical with the cut
+    on or off; only exchange C traffic and merge width shrink.
+
+    Returns (ucols(6), uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd),
+    n_giant_lines, n_giant_pairs, n_pairs_total, n_ha_cut).  ovf_cd is
+    exchange C's inter-host (DCN) hop overflow; always 0 on the flat path.
+    n_ha_cut counts sketch-cut pair rows (0 when ha_cut is None).
+    """
+    from ..ops import sketch
+    num_dev = jax.lax.psum(1, AXIS)
+    (pcols, pvalid2, pcnt, (ovf_p, ovf_g, ovf_gp),
+     n_giant_lines, n_giant_pairs, n_pairs_total) = _emit_local_pairs(
+        jv, code, v1, v2, n_rows, dep_f, ref_f, cap_pairs=cap_pairs,
+        cap_giant=cap_giant, cap_giant_pairs=cap_giant_pairs, skew=skew,
+        pass_idx=pass_idx, n_pass=n_pass)
+
+    n_ha_cut = jnp.int32(0)
+    if ha_cut is not None:
+        table, ha_bits, ha_hashes, ha_thresh = ha_cut
+        est = sketch.count_min_query(table, _ha_pair_keys(pcols),
+                                     bits=ha_bits, num_hashes=ha_hashes)
+        keep = est >= ha_thresh
+        n_ha_cut = jax.lax.psum(jnp.where(pvalid2 & ~keep, 1, 0).sum(), AXIS)
+        pvalid2 = pvalid2 & keep
 
     # Exchange C: co-locate pair partials with the dependent capture's owner.
     # Hierarchical mode sum-combines each host's partial counts per pair key
@@ -681,15 +742,15 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
     cooc = jax.ops.segment_sum(jnp.where(mvalid, mcnt_in, 0),
                                jnp.clip(uinv, 0, m - 1), num_segments=m)
     return (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd),
-            n_giant_lines, n_giant_pairs, n_pairs_total)
+            n_giant_lines, n_giant_pairs, n_pairs_total, n_ha_cut)
 
 
 # Packed per-pass control lanes (exchange.pack_counters): 5 overflow counters
 # followed by the tail counters.  ONE lane array per pass is the whole
 # device->host control surface of the pipelined executor — the host reads it
 # in a single async-staged pull instead of 3+ blocking host_gathers.
-_TELE_LANES = 8  # [ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd, n_giant_lines,
-#                  n_giant_pairs, n_pairs_total]
+_TELE_LANES = 9  # [ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd, n_giant_lines,
+#                  n_giant_pairs, n_pairs_total, n_ha_cut]
 _N_OVF = 5
 
 
@@ -701,7 +762,7 @@ def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
     n = jv.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
     (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd),
-     n_giant_lines, n_giant_pairs, n_pairs_total) = _pair_phase(
+     n_giant_lines, n_giant_pairs, n_pairs_total, n_ha_cut) = _pair_phase(
         jv, code, v1, v2, n_rows[0], valid, valid, cap_pairs=cap_pairs,
         cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
         cap_giant_pairs=cap_giant_pairs, skew=skew,
@@ -723,7 +784,7 @@ def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
     out_cols, n_out = segments.compact(list(ucols) + [dep_count], keep)
     tele = exchange.pack_counters([ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd,
                                    n_giant_lines, n_giant_pairs,
-                                   n_pairs_total])
+                                   n_pairs_total, n_ha_cut])
     return (*out_cols, jnp.full(1, n_out, jnp.int32), tele)
 
 
@@ -766,6 +827,32 @@ CAP_FLOOR = 512
 # transients, comfortable inside a v5e's 16 GB HBM next to the resident
 # lines; hosts proxying many fake devices in one address space set it lower.
 PAIR_ROW_BUDGET = 1 << 25
+
+# Sharded half-approximate 1/1 (the distributed two-round count-min cut).
+# Depth 2 matches the single-device half-approx round's spectral filter
+# economics: two probes halve the collision overestimate per doubling of
+# query cost, and the cut is correctness-neutral at any depth.
+_HA_HASHES = 2
+_HA_DEF_BITS = 1 << 16
+
+
+def sharded_half_approx_enabled() -> bool:
+    """RDFIND_SHARDED_HALF_APPROX: run strategies' pair verification as the
+    sharded two-round count-min 1/1 (round 1 builds per-device partial
+    sketches + all-reduces them; round 2 cuts sub-support candidates before
+    exchange C).  auto/0/1; auto (default) = off until benched on.  Output
+    is bit-identical either way — the sketch only prunes candidates the
+    support filter would discard."""
+    v = os.environ.get("RDFIND_SHARDED_HALF_APPROX", "auto").strip().lower()
+    return v in ("1", "on", "true", "yes")
+
+
+def sharded_ha_bits() -> int:
+    """RDFIND_SHARDED_HA_BITS: count-min table width for the sharded
+    two-round (power of two, min 32; default 2^16 = 256 KiB of int32 per
+    device — one table, independent of mesh size)."""
+    v = int(os.environ.get("RDFIND_SHARDED_HA_BITS", _HA_DEF_BITS))
+    return max(32, segments.pow2_capacity(max(v, 1)))
 
 
 def _shard_triples(triples, num_dev, t_loc: int | None = None):
@@ -963,6 +1050,16 @@ class _Pipeline:
         # pipeline — the per-pass path pays attribute checks only.  The env
         # knob must agree across hosts (same contract as RDFIND_TRACE).
         self._datastats_on = datastats.enabled()
+
+        # Sharded half-approximate 1/1 (RDFIND_SHARDED_HALF_APPROX): resolved
+        # once so every run_cooc level sees one consistent configuration.
+        # The cut threshold is clamped to the sketch cap — counters saturate
+        # at cap, so a pair meeting min_support > cap still reads >= cap.
+        from ..ops import sketch
+        self.ha_on = sharded_half_approx_enabled()
+        self.ha_bits = sharded_ha_bits()
+        self.ha_hashes = _HA_HASHES
+        self.ha_thresh = min(int(min_support), sketch.MAX_COUNT_MIN_CAP)
 
         # P1: measured plan for the pre-exchange capacities.  Hierarchical
         # mode also measures the DCN-hop (host-combined) loads exactly.
@@ -1384,7 +1481,8 @@ class _Pipeline:
         return (jnp.full(1, p, jnp.int32), jnp.full(1, self.n_pass, jnp.int32))
 
     def _run_passes(self, step, what: str, *, site: str = "cind",
-                    phase_key: str | None = None, fp_extra=None):
+                    phase_key: str | None = None, fp_extra=None,
+                    ledger_sites=("exchange_c", "giant_gather")):
         """Pipelined dep-slice pass executor — the shared scaffolding of
         run_cinds and run_cooc.  `step(pass_args)` must return device arrays
         (cols, n_out, telemetry) with telemetry an exchange.pack_counters
@@ -1435,7 +1533,7 @@ class _Pipeline:
         while True:
             try:
                 return self._attempt_passes(step, what, site, phase_key, seq,
-                                            fp_extra)
+                                            fp_extra, ledger_sites)
             except _PairCapsExhausted as e:
                 if faults.strict_mode():
                     raise RuntimeError(e.msg) from None
@@ -1468,7 +1566,8 @@ class _Pipeline:
                     continue
                 raise faults.FallbackRequired(what, e.msg) from None
 
-    def _attempt_passes(self, step, what, site, phase_key, seq, fp_extra):
+    def _attempt_passes(self, step, what, site, phase_key, seq, fp_extra,
+                        ledger_sites=("exchange_c", "giant_gather")):
         """One ladder attempt of the pipelined pass loop at the current
         n_pass/caps (see _run_passes for the schedule contract)."""
         d = dispatch.DispatchStats(pull_base=self._pull_base)
@@ -1529,20 +1628,30 @@ class _Pipeline:
                         # a rollback, so the ledger records dispatches, not
                         # committed passes.
                         hier_on = self.hier is not None
-                        pend = [exchange.log_exchange(
-                            self.stats, "exchange_c", num_dev=self.num_dev,
-                            capacity=self.cap_c, lanes=_LANES_EXCHANGE_C,
-                            hosts=self.hosts, hier=hier_on,
-                            dcn_capacity=self.cap_c_dcn if hier_on else None)]
+                        pend = []
+                        # ledger_sites names the exchanges this phase's step
+                        # actually dispatches: the sketch-build phase of the
+                        # sharded half-approx round has no exchange C (pairs
+                        # never leave the device), so it must not ledger one.
+                        if "exchange_c" in ledger_sites:
+                            pend.append(exchange.log_exchange(
+                                self.stats, "exchange_c",
+                                num_dev=self.num_dev, capacity=self.cap_c,
+                                lanes=_LANES_EXCHANGE_C, hosts=self.hosts,
+                                hier=hier_on,
+                                dcn_capacity=(self.cap_c_dcn if hier_on
+                                              else None)))
                         # The giant-line all_gather is topology-oblivious
                         # (whole lines replicate everywhere) — hier=False, but
                         # host attribution still splits its ICI/DCN bytes.
-                        pend.append(exchange.log_exchange(
-                            self.stats, "giant_gather", num_dev=self.num_dev,
-                            capacity=min(
-                                self.cap_g,
-                                self.lines[0].shape[0] // self.num_dev),
-                            lanes=_LANES_GIANT, hosts=self.hosts))
+                        if "giant_gather" in ledger_sites:
+                            pend.append(exchange.log_exchange(
+                                self.stats, "giant_gather",
+                                num_dev=self.num_dev,
+                                capacity=min(
+                                    self.cap_g,
+                                    self.lines[0].shape[0] // self.num_dev),
+                                lanes=_LANES_GIANT, hosts=self.hosts))
                         t0 = time.perf_counter() if self._timed else 0.0
                         cols, n_out, tele = step(self._pass_args(p_next))
                         if self._timed:
@@ -1593,7 +1702,7 @@ class _Pipeline:
                     # traffic).  The lanes are global psum totals, so the
                     # fractions are average-per-device estimates; skew puts
                     # the max higher, which the overflow ladder owns.
-                    ngl_p, ngp_p, npt_p = teles[p]
+                    ngl_p, ngp_p, npt_p = teles[p][:3]
                     fr = {"pairs": ((npt_p - ngp_p)
                                     / max(self.num_dev * self.cap_p, 1)),
                           "giant_pairs": (ngp_p
@@ -1653,9 +1762,9 @@ class _Pipeline:
             *cols, n_out, tele = out
             return cols, n_out, tele
 
-        blocks, (ngl, ngp, npt) = self._run_passes(step, "pair-phase",
-                                                   site="cind",
-                                                   phase_key="cind")
+        blocks, (ngl, ngp, npt, _) = self._run_passes(step, "pair-phase",
+                                                      site="cind",
+                                                      phase_key="cind")
         if self.stats is not None:
             # max across passes: a mid-run cap_p growth shifts the giant
             # threshold between passes, so the last pass may see fewer giants
@@ -1667,28 +1776,116 @@ class _Pipeline:
             metrics.counter_add(self.stats, "total_pairs", sum(npt))
         return blocks
 
-    def run_cooc(self, fcode, fv1, fv2, fflag, n_flags, stat_key):
-        """S2L level verification over the device-resident lines."""
+    def _ha_build_table(self, fcode, fv1, fv2, fflag, n_flags, stat_key,
+                        digest):
+        """Round 1 of the sharded half-approximate 1/1: build per-pass
+        per-device count-min partial tables over the level's pair stream
+        (same ladder/progress machinery as the verification passes — an
+        incomplete build would make the cut unsound), then fold + all-reduce
+        them in ONE device dispatch and return the host copy of the global
+        table.  Returns a numpy (ha_bits,) int32 array."""
         def step(pass_args):
-            out = _s2l_cooc(*self.lines, self.n_rows, fcode, fv1, fv2, fflag,
-                            n_flags, *pass_args, mesh=self.mesh,
-                            **self._pair_caps())
-            *cols, n_out, tele = out
-            return cols, n_out, tele
+            table, n_out, tele = _s2l_sketch_build(
+                *self.lines, self.n_rows, fcode, fv1, fv2, fflag, n_flags,
+                *pass_args, mesh=self.mesh, cap_pairs=self.cap_p,
+                cap_giant=self.cap_g, cap_giant_pairs=self.cap_gp,
+                skew=self.skew, ha_bits=self.ha_bits,
+                ha_hashes=self.ha_hashes)
+            return [table], n_out, tele
 
+        blocks, (ngl, ngp, npt, _) = self._run_passes(
+            step, "HA sketch build", site="cooc", phase_key=f"{stat_key}:ha1",
+            fp_extra={"flags": digest,
+                      "ha": [self.ha_bits, self.ha_hashes, self.ha_thresh]},
+            ledger_sites=("giant_gather",))
+        from ..ops import sketch
+        # blocks[0] concatenates per-pass (D*bits,) collect_blocks pulls;
+        # rearrange device-major so each device's shard_map slice holds its
+        # own per-pass partials, then fold + saturating-all-reduce on device.
+        parts = np.asarray(blocks[0], np.int32).reshape(
+            -1, self.num_dev, self.ha_bits)
+        stacked = np.ascontiguousarray(
+            parts.transpose(1, 0, 2).reshape(self.num_dev, -1))
+        hier_on = self.hier is not None
+        pend = [exchange.log_sketch_allreduce(
+            self.stats, num_dev=self.num_dev, bits=self.ha_bits,
+            hosts=self.hosts, hier=hier_on)]
+        t0 = time.perf_counter() if self._timed else 0.0
+        out = _ha_reduce_step(make_global(stacked, self.mesh),
+                              mesh=self.mesh, bits=self.ha_bits,
+                              cap=sketch.MAX_COUNT_MIN_CAP, hier=self.hier)
+        if self._timed:
+            jax.block_until_ready(out)
+            exchange.log_dispatch_timing(self.stats, pend,
+                                         (time.perf_counter() - t0) * 1e3)
+        table = np.asarray(host_gather(out)).reshape(-1, self.ha_bits)[0]
+        if self.stats is not None:
+            metrics.counter_add(self.stats, "ha_build_rounds")
+            metrics.counter_add(self.stats, "total_pairs", sum(npt))
+            metrics.counter_max(self.stats, "n_giant_lines", max(ngl))
+            metrics.counter_add(self.stats, "n_giant_pairs", sum(ngp))
+            metrics.gauge_set(self.stats, "ha_sketch_bits", self.ha_bits)
+            metrics.gauge_set(self.stats, "ha_sketch_bytes",
+                              self.ha_bits * 4)
+            if self._datastats_on:
+                # Sketch load factor as a cap-utilization row: occupied
+                # counters vs table width — the dial for
+                # RDFIND_SHARDED_HA_BITS (a saturated table still only
+                # weakens the cut, never correctness).
+                datastats.publish_cap_utilization(
+                    self.stats, {"ha_sketch": self.ha_bits},
+                    {"ha_sketch": int(np.count_nonzero(table))})
+        return table
+
+    def run_cooc(self, fcode, fv1, fv2, fflag, n_flags, stat_key):
+        """S2L level verification over the device-resident lines.
+
+        With RDFIND_SHARDED_HALF_APPROX on, runs the distributed two-round
+        count-min 1/1 instead: round 1 builds + all-reduces the level's
+        sketch (_ha_build_table), round 2 is the exact verification below
+        with the sketch cut dropping sub-support pairs before exchange C.
+        Output is bit-identical either way; the knob-off path runs the
+        exact program (and progress fingerprints) it always ran."""
         # The level's flag table is part of the phase identity: a progress
         # snapshot from one lattice level must never satisfy another.
         digest = hashlib.sha256(b"".join(
             np.ascontiguousarray(a).tobytes()
             for a in (fcode, fv1, fv2, fflag, n_flags))).hexdigest()
-        blocks, (ngl, ngp, npt) = self._run_passes(
+        ha_table = None
+        if self.ha_on:
+            ha_table = self._ha_build_table(fcode, fv1, fv2, fflag, n_flags,
+                                            stat_key, digest)
+
+        def step(pass_args):
+            if ha_table is None:
+                out = _s2l_cooc(*self.lines, self.n_rows, fcode, fv1, fv2,
+                                fflag, n_flags, *pass_args, mesh=self.mesh,
+                                **self._pair_caps())
+            else:
+                out = _s2l_cooc_ha(*self.lines, self.n_rows, fcode, fv1, fv2,
+                                   fflag, n_flags, *pass_args, ha_table,
+                                   mesh=self.mesh, **self._pair_caps(),
+                                   ha=(self.ha_bits, self.ha_hashes,
+                                       self.ha_thresh))
+            *cols, n_out, tele = out
+            return cols, n_out, tele
+
+        fp_extra = {"flags": digest}
+        if ha_table is not None:
+            # The cut changes exchange-C contents, so round-2 snapshots must
+            # not satisfy (or be satisfied by) knob-off runs.  Knob-off
+            # fingerprints are byte-identical to the historical ones.
+            fp_extra["ha"] = [self.ha_bits, self.ha_hashes, self.ha_thresh]
+        blocks, (ngl, ngp, npt, nha) = self._run_passes(
             step, "sharded S2L cooc", site="cooc", phase_key=stat_key,
-            fp_extra={"flags": digest})
+            fp_extra=fp_extra)
         if self.stats is not None:
             metrics.gauge_set(self.stats, stat_key, sum(npt))
             metrics.counter_add(self.stats, "total_pairs", sum(npt))
             metrics.counter_max(self.stats, "n_giant_lines", max(ngl))
             metrics.counter_add(self.stats, "n_giant_pairs", sum(ngp))
+            if ha_table is not None:
+                metrics.counter_add(self.stats, "ha_cut_pairs", sum(nha))
         return blocks
 
 
@@ -1800,11 +1997,15 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
 # ---------------------------------------------------------------------------
 
 
-def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
-                     pass_idx, n_pass, *, cap_pairs, cap_exchange_c, cap_giant,
-                     cap_giant_pairs, skew=DEFAULT_SKEW, cap_exchange_c_dcn=0,
-                     hier=None, dcn_chunks=1):
-    """One level's verification: join flags onto rows, masked pair phase."""
+def _s2l_flag_rows(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags):
+    """Join the level's broadcast (dep?, ref?) flags onto the resident rows
+    and compact away never-relevant rows.  Shared by the verification step
+    and the round-1 sketch build, which must see the identical pair stream.
+
+    Dropping never-relevant rows BEFORE the quadratic layout is THE saving of
+    this strategy (cf. small_to_large._chunked_cooc's row_keep).  compact
+    preserves the (value, capture) sort order.
+    """
     n = jv.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < n_rows[0]
     fvalid = jnp.arange(fcode.shape[0], dtype=jnp.int32) < n_flags[0]
@@ -1813,23 +2014,33 @@ def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
     dep_f = valid & (flags >= 2)
     ref_f = valid & (flags % 2 == 1)
     keep = dep_f | ref_f
-    # Dropping never-relevant rows BEFORE the quadratic layout is THE saving of
-    # this strategy (cf. small_to_large._chunked_cooc's row_keep).  compact
-    # preserves the (value, capture) sort order.
-    (jv2, code2, v12, v22, df2, rf2), n_keep = segments.compact(
-        [jv, code, v1, v2, dep_f, ref_f], keep)
+    return segments.compact([jv, code, v1, v2, dep_f, ref_f], keep)
+
+
+def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
+                     pass_idx, n_pass, ha_table=None, *, cap_pairs,
+                     cap_exchange_c, cap_giant, cap_giant_pairs,
+                     skew=DEFAULT_SKEW, cap_exchange_c_dcn=0,
+                     hier=None, dcn_chunks=1, ha=None):
+    """One level's verification: join flags onto rows, masked pair phase.
+
+    ha=(bits, num_hashes, thresh) + the replicated all-reduced ha_table arm
+    the round-2 count-min candidate cut inside the pair phase."""
+    (jv2, code2, v12, v22, df2, rf2), n_keep = _s2l_flag_rows(
+        jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags)
+    ha_cut = None if ha is None else (ha_table, ha[0], ha[1], ha[2])
     (ucols, uvalid, cooc, (ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd),
-     n_giant_lines, n_giant_pairs, n_pairs_total) = _pair_phase(
+     n_giant_lines, n_giant_pairs, n_pairs_total, n_ha_cut) = _pair_phase(
         jv2, code2, v12, v22, n_keep, df2, rf2, cap_pairs=cap_pairs,
         cap_exchange_c=cap_exchange_c, cap_giant=cap_giant,
         cap_giant_pairs=cap_giant_pairs, skew=skew,
         pass_idx=pass_idx[0], n_pass=n_pass[0],
         cap_exchange_c_dcn=cap_exchange_c_dcn, hier=hier,
-        dcn_chunks=dcn_chunks)
+        dcn_chunks=dcn_chunks, ha_cut=ha_cut)
     out_cols, n_out = segments.compact(list(ucols) + [cooc], uvalid)
     tele = exchange.pack_counters([ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd,
                                    n_giant_lines, n_giant_pairs,
-                                   n_pairs_total])
+                                   n_pairs_total, n_ha_cut])
     return (*out_cols, jnp.full(1, n_out, jnp.int32), tele)
 
 
@@ -1854,6 +2065,110 @@ def _s2l_cooc(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
         check_vma=False,
     )(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
       pass_idx, n_pass)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "cap_pairs", "cap_exchange_c", "cap_giant",
+                     "cap_giant_pairs", "skew", "cap_exchange_c_dcn", "hier",
+                     "dcn_chunks", "ha"))
+def _s2l_cooc_ha(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
+                 pass_idx, n_pass, ha_table, *, mesh, cap_pairs,
+                 cap_exchange_c, cap_giant, cap_giant_pairs,
+                 skew=DEFAULT_SKEW, cap_exchange_c_dcn=0, hier=None,
+                 dcn_chunks=1, ha=None):
+    """_s2l_cooc with the round-2 count-min cut armed.  A separate jit (extra
+    replicated ha_table operand + static ha triple) so the knob-off path
+    compiles the exact program it compiled before this feature existed."""
+    fn = functools.partial(
+        _s2l_cooc_device, cap_pairs=cap_pairs, cap_exchange_c=cap_exchange_c,
+        cap_giant=cap_giant, cap_giant_pairs=cap_giant_pairs, skew=skew,
+        cap_exchange_c_dcn=cap_exchange_c_dcn, hier=hier,
+        dcn_chunks=dcn_chunks, ha=ha)
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(AXIS),) * 5 + (P(),) * 8,
+        out_specs=P(AXIS),
+        check_vma=False,
+    )(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
+      pass_idx, n_pass, ha_table)
+
+
+def _s2l_sketch_build_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag,
+                             n_flags, pass_idx, n_pass, *, cap_pairs,
+                             cap_giant, cap_giant_pairs, skew=DEFAULT_SKEW,
+                             ha_bits, ha_hashes):
+    """Round 1 of the sharded half-approximate 1/1: one dep-slice pass of the
+    level's pair stream folded into a per-device count-min partial table.
+
+    Runs the SAME flag join + `_emit_local_pairs` emission as the
+    verification step (same caps, same dep-slice hashing), so the partial
+    counts sum — over devices and passes — to each pair's exact global cooc,
+    and the pass loop's overflow ladder keeps the build complete (an
+    incomplete build would under-estimate and make the round-2 cut unsound).
+    No exchange C here: the pairs never leave the device, only the dense
+    (bits,) table does, via `exchange.sketch_allreduce`.
+    """
+    from ..ops import sketch
+    (jv2, code2, v12, v22, df2, rf2), n_keep = _s2l_flag_rows(
+        jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags)
+    (pcols, pvalid2, pcnt, (ovf_p, ovf_g, ovf_gp),
+     n_giant_lines, n_giant_pairs, n_pairs_total) = _emit_local_pairs(
+        jv2, code2, v12, v22, n_keep, df2, rf2, cap_pairs=cap_pairs,
+        cap_giant=cap_giant, cap_giant_pairs=cap_giant_pairs, skew=skew,
+        pass_idx=pass_idx[0], n_pass=n_pass[0])
+    table = sketch.count_min_partial(_ha_pair_keys(pcols), pcnt, pvalid2,
+                                     bits=ha_bits, num_hashes=ha_hashes)
+    z = jnp.int32(0)
+    tele = exchange.pack_counters([ovf_p, z, ovf_g, ovf_gp, z, n_giant_lines,
+                                   n_giant_pairs, n_pairs_total, z])
+    return table, jnp.full(1, ha_bits, jnp.int32), tele
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "cap_pairs", "cap_giant", "cap_giant_pairs",
+                     "skew", "ha_bits", "ha_hashes"))
+def _s2l_sketch_build(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag,
+                      n_flags, pass_idx, n_pass, *, mesh, cap_pairs,
+                      cap_giant, cap_giant_pairs, skew=DEFAULT_SKEW,
+                      ha_bits, ha_hashes):
+    fn = functools.partial(
+        _s2l_sketch_build_device, cap_pairs=cap_pairs, cap_giant=cap_giant,
+        cap_giant_pairs=cap_giant_pairs, skew=skew, ha_bits=ha_bits,
+        ha_hashes=ha_hashes)
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(AXIS),) * 5 + (P(),) * 7,
+        out_specs=P(AXIS),
+        check_vma=False,
+    )(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
+      pass_idx, n_pass)
+
+
+def _ha_reduce_device(parts, *, bits, cap, hier):
+    """Fold one device's per-pass partial tables, then all-reduce.
+
+    Each partial is already capped at `cap` <= 2^16-1, so the running int32
+    sum stays far below wrap for any realistic pass count; the saturating
+    minimum after every add keeps the value on the wire bounded by cap —
+    the precondition of the saturation lemma (ops/sketch.py) that makes
+    this bit-identical to host `merge_count_min`.
+    """
+    p = parts.reshape(-1, bits)
+
+    def body(acc, row):
+        return jnp.minimum(acc + row, cap), None
+
+    tbl, _ = jax.lax.scan(body, jnp.zeros(bits, jnp.int32), p)
+    return exchange.sketch_allreduce(tbl, AXIS, cap=cap, hier=hier)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "bits", "cap", "hier"))
+def _ha_reduce_step(parts, *, mesh, bits, cap, hier=None):
+    fn = functools.partial(_ha_reduce_device, bits=bits, cap=cap, hier=hier)
+    return shard_map(fn, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+                     check_vma=False)(parts)
 
 
 class _ShardedCooc:

@@ -248,6 +248,15 @@ def _half_approx_cooc_11(line_val_h, line_cap_h, dep_ok, ref_ok, budget, stats,
     (cnt == |dep| >= min_support) nor a proper overlap, so the result is
     output-equivalent to the exact path for every downstream consumer.
     Sketch collisions only enlarge round 2, never change the output.
+
+    This host-side round is single-device chunked-backend only.  Sharded runs
+    (--dop > 1, any strategy verifying through models/sharded) have their own
+    distributed descendant — RDFIND_SHARDED_HALF_APPROX=1 builds per-device
+    count-min partial tables over the same pair stream, all-reduces them with
+    a saturating psum (exchange.sketch_allreduce, bit-identical to host
+    merge_count_min by the saturation lemma in ops/sketch.py), and applies the
+    round-2 cut before exchange C — same soundness argument as above, same
+    bit-identical-output contract.
     """
     cap = _sbf_cap(sbf_bits)
     threshold = max(0, int(explicit_threshold))

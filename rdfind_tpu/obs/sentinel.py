@@ -114,6 +114,24 @@ METRIC_SPECS = (
      ("detail", "kernel_feed", "mesh8", "overlap_efficiency"), "higher"),
     ("kernel_feed_scaling_efficiency",
      ("detail", "kernel_feed", "scaling_efficiency"), "higher"),
+    # Sharded half-approximate two-round rows (bench_half_approx.py): mesh
+    # {1,4,8} throughput, the mesh-8 per-device working set (pair buffers +
+    # replicated sketch — the equal-memory bound), and the sketch
+    # all-reduce's DCN bytes on the 2-host proxy, flat vs hierarchical (the
+    # hier reduce must keep paying its factor-`local` cut).
+    ("half_approx_mesh1_triples_per_sec",
+     ("detail", "half_approx", "mesh1", "triples_per_sec"), "higher"),
+    ("half_approx_mesh4_triples_per_sec",
+     ("detail", "half_approx", "mesh4", "triples_per_sec"), "higher"),
+    ("half_approx_mesh8_triples_per_sec",
+     ("detail", "half_approx", "mesh8", "triples_per_sec"), "higher"),
+    ("half_approx_mesh8_working_set_bytes",
+     ("detail", "half_approx", "mesh8", "working_set_bytes_per_device"),
+     "lower"),
+    ("half_approx_sketch_dcn_bytes_flat",
+     ("detail", "half_approx", "sketch_reduce", "dcn_bytes_flat"), "lower"),
+    ("half_approx_sketch_dcn_bytes_hier",
+     ("detail", "half_approx", "sketch_reduce", "dcn_bytes_hier"), "lower"),
 )
 _DIRECTIONS = {name: d for name, _, d in METRIC_SPECS}
 

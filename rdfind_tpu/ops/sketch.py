@@ -281,6 +281,44 @@ def count_min_add(keys, counts, valid, *, bits: int, num_hashes: int,
     return table
 
 
+def count_min_partial(keys, counts, valid, *, bits: int, num_hashes: int,
+                      cap: int = MAX_COUNT_MIN_CAP, table=None):
+    """One shard's partial count-min table, optionally folded into `table`.
+
+    The shard-clean build contract: `bit_positions` is row-pure, so rows
+    hash to the same counters no matter which device (or which dep-slice
+    pass) holds them, and a partial table built with the same STATIC
+    (bits, num_hashes, cap) is summable with any other — sum-then-cap over
+    per-device partials is bit-identical to one `count_min_add` over the
+    concatenated rows.  (The per-row clip at `cap` commutes with sharding
+    because it is per-row, not per-shard-total.)
+
+    Saturation contract (the all-reduce correctness lemma): for
+    non-negative partial sums s_i,
+
+        min(sum_i min(s_i, cap), cap) == min(sum_i s_i, cap)
+
+    — if every s_i <= cap the inner min is the identity; otherwise some
+    s_i > cap forces both sides to cap.  The lemma nests, so saturating
+    after EVERY reduction level — the per-chunk clamp inside
+    `count_min_add`'s scan, the per-pass fold here, the intra-host psum
+    and the inter-host psum of `exchange.sketch_allreduce`, and the host
+    `merge_count_min` — yields the same bits as one global sum-then-cap,
+    while bounding every wire operand at `cap` (<= 2^16-1), so the int32
+    psum cannot wrap below 2^15 participants per level.
+
+    `table=None` returns this shard's partial; otherwise the partial is
+    folded into the running table with the same saturating rule (the
+    per-pass accumulation of the sharded two-round's round 1).
+    """
+    part = count_min_add(keys, counts, valid, bits=bits,
+                         num_hashes=num_hashes, cap=cap)
+    if table is None:
+        return part
+    # Both operands are <= cap <= 2^16-1, so the int32 sum cannot wrap.
+    return jnp.minimum(table + part, cap)
+
+
 @functools.partial(jax.jit, static_argnames=("bits", "num_hashes"))
 def count_min_query(table, keys, *, bits: int, num_hashes: int):
     """Upper bound on each key's count: min over its k counters (getCount analog)."""
@@ -405,7 +443,16 @@ def kernel_selfcheck(n_rows: int = 1024, n_bits: int = 4096,
 
 
 def merge_count_min(tables, cap: int = MAX_COUNT_MIN_CAP):
-    """Sum of count-min tables (the combiner-tree merge), saturating."""
+    """Sum of count-min tables (the combiner-tree merge), saturating.
+
+    Host reference for the device-side saturating reduction
+    (`exchange.sketch_allreduce`): this computes the exact int64 sum first
+    and caps ONCE at the end, while the device path caps after every psum
+    level — `count_min_partial`'s saturation lemma proves the two agree bit
+    for bit whenever every input table is itself <= cap (which
+    `count_min_add` guarantees).  Pinned by the differential test in
+    tests/test_sketch_saturation.py, at and past the cap.
+    """
     acc = np.zeros_like(np.asarray(tables[0]), dtype=np.int64)
     for t in tables:
         acc += np.asarray(t, np.int64)

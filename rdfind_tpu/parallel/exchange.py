@@ -152,6 +152,90 @@ def log_exchange(stats, site: str, *, num_dev: int, capacity: int,
     return split
 
 
+SKETCH_ALLREDUCE_SITE = "sketch_allreduce"
+
+
+def sketch_allreduce_bytes(num_dev: int, bits: int, *, hosts: int = 1,
+                           hier: bool = False):
+    """(ici_bytes, dcn_bytes) of ONE dense (bits,) int32 table all-reduce.
+
+    Same per-device-to-each-destination attribution as exchange_split_bytes,
+    applied to a dense operand: flat, every device's table reaches d-1 peers
+    (local-1 on-host rows ride ICI, d-local cross DCN).  Hierarchical, the
+    intra-host psum moves the same ICI volume but each host then crosses DCN
+    with ONE pre-reduced table per inter-group member — hosts-1 cross-host
+    copies per device instead of d-local, a factor-`local` DCN reduction
+    (the PR-8 combiner shape, with summation as the combine).
+    """
+    d, b = int(num_dev), int(bits) * 4
+    hosts = max(1, int(hosts))
+    local = max(1, d // hosts)
+    ici = d * (local - 1) * b
+    dcn = d * (hosts - 1) * b if hier else d * (d - local) * b
+    return ici, dcn
+
+
+def log_sketch_allreduce(stats, *, num_dev: int, bits: int, hosts: int = 1,
+                         hier: bool = False, calls: int = 1):
+    """Ledger entry for the dense count-min all-reduce site.
+
+    Mirrors log_exchange for the sharded two-round's sketch reduction: the
+    site rides the same exchange_sites struct, so the --debug exchange
+    lines, the Prometheus export, and log_dispatch_timing's wall/GB/s/
+    link_util attribution all cover it with zero renderer changes.
+    `capacity` records the table width (counters); one int32 lane.
+    Returns the split part-dict for log_dispatch_timing.
+    """
+    ici1, dcn1 = sketch_allreduce_bytes(num_dev, bits, hosts=hosts, hier=hier)
+    nbytes = calls * (ici1 + dcn1)
+    split = {"site": SKETCH_ALLREDUCE_SITE, "bytes": nbytes,
+             "ici": calls * ici1, "dcn": calls * dcn1, "reply": 0}
+    if stats is None:
+        return split
+
+    def fn(c):
+        e = c.setdefault("exchange_sites", {}).setdefault(
+            SKETCH_ALLREDUCE_SITE, _empty_site_entry(1))
+        e["calls"] += calls
+        e["capacity"] = max(e["capacity"], int(bits))
+        e["lanes"] = 1
+        e["bytes"] += nbytes
+        e["ici_bytes"] += calls * ici1
+        e["dcn_bytes"] += calls * dcn1
+        e["hier"] = max(e.get("hier", 0), 1 if hier else 0)
+        e["rows_capacity"] += calls * int(num_dev) * int(bits)
+        e["rows"] += calls * int(num_dev) * int(bits)
+
+    metrics.mutate(stats, fn, key="exchange_sites", kind=metrics.STRUCT)
+    tracer.instant("exchange", cat=tracer.CAT_EXCHANGE,
+                   site=SKETCH_ALLREDUCE_SITE, calls=calls,
+                   capacity=int(bits), bytes=nbytes, dcn_bytes=calls * dcn1)
+    return split
+
+
+def sketch_allreduce(table, axis_name: str, *, cap: int, hier=None):
+    """Saturating all-reduce of per-device count-min partial tables.
+
+    Bit-identical to the host `ops.sketch.merge_count_min` over the gathered
+    partials by the saturation lemma (ops.sketch.count_min_partial): the cap
+    is re-applied after EVERY psum level, so each wire operand stays <= cap
+    and the result equals one global sum-then-cap.
+
+    Flat (`hier=None`): one global psum, one cap.  Hierarchical
+    (`hier=(hosts, local)`): intra-host psum over the ICI groups, cap, then
+    the pre-reduced per-host table psums across the DCN groups
+    (hier_groups) — `local`x fewer DCN bytes than the flat reduce
+    (sketch_allreduce_bytes), same bits out on every device.
+    """
+    if hier is None:
+        return jnp.minimum(jax.lax.psum(table, axis_name), cap)
+    intra, inter = hier_groups(hier)
+    t = jnp.minimum(
+        jax.lax.psum(table, axis_name, axis_index_groups=intra), cap)
+    return jnp.minimum(
+        jax.lax.psum(t, axis_name, axis_index_groups=inter), cap)
+
+
 def collective_timing_enabled() -> bool:
     """Whether the per-site collective timers are armed
     (RDFIND_COLLECTIVE_TIMING=1).  Off by default: timing a dispatch means

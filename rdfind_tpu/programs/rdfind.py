@@ -106,7 +106,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--balanced-overlap-candidates", action="store_true",
                    dest="balanced_11",
                    help="halve the 1/1 overlap emission via pair ownership "
-                        "(strategy 1, chunked backend)")
+                        "(strategy 1, single-device chunked backend; sharded "
+                        "runs split emission via giant-line slicing instead)")
     p.add_argument("--rebalance-strategy", type=int, default=1,
                    choices=(1, 2),
                    help="split-line dependent ownership: 1 = hash-slice, "
@@ -129,11 +130,13 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument(flag, type=int, default=dv, help=argparse.SUPPRESS)
     p.add_argument("--explicit-threshold", type=int, default=-1,
                    help="half-approximate 1/1 round: max exact per-dependent "
-                        "counters (strategy 1, single-device; -1 = exact "
-                        "overlaps).  Sharded runs keep exact one-pass 1/1 by "
-                        "policy: planned capacities + dep-slice streaming "
-                        "(RDFIND_PAIR_ROW_BUDGET) already give the spectral "
-                        "round's memory bound")
+                        "counters (strategy 1, single-device chunked backend "
+                        "only; -1 = exact overlaps).  Sharded runs bound 1/1 "
+                        "memory via planned capacities + dep-slice streaming "
+                        "(RDFIND_PAIR_ROW_BUDGET); their distributed "
+                        "two-round count-min cut is "
+                        "RDFIND_SHARDED_HALF_APPROX=1 (bit-identical "
+                        "output)")
     p.add_argument("--sbf-bytes", type=int, default=-1, dest="sbf_bits",
                    help="bits per spectral (count-min) counter for the "
                         "half-approximate round (-1 = sized to support)")

@@ -245,9 +245,12 @@ def _skew_from_cfg(cfg: Config) -> "sharded.SkewPolicy":
 
 
 def _half_approx_active(cfg: Config) -> bool:
-    """Whether --explicit-threshold actually selects the half-approximate 1/1
-    round: default strategy, single device (the sharded S2L has no
-    half-approximate mode yet)."""
+    """Whether --explicit-threshold actually selects the single-device
+    half-approximate 1/1 round: default strategy, single device.  Sharded
+    runs have their own two-round count-min mode — env-gated
+    (RDFIND_SHARDED_HALF_APPROX, resolved inside models/sharded), not
+    flag-gated, because its output is bit-identical and so never part of
+    the run's logical configuration."""
     return (cfg.explicit_threshold != -1 and cfg.traversal_strategy == 1
             and cfg.n_devices == 1)
 
@@ -841,12 +844,14 @@ def _run(cfg: Config) -> RunResult:
                       "single-device chunked backend; the sharded run sizes "
                       "its merge buffers from measured loads", file=sys.stderr)
             if cfg.explicit_threshold != -1:
-                print("note: --explicit-threshold (half-approximate 1/1) is "
-                      "single-device only BY POLICY: sharded runs bound 1/1 "
-                      "memory exactly via planned capacities + dep-slice "
-                      "streaming passes (RDFIND_PAIR_ROW_BUDGET), achieving "
-                      "the spectral round's memory bound in one exact pass "
-                      "(measured: HALF_APPROX_*.jsonl)", file=sys.stderr)
+                print("note: --explicit-threshold (spectral half-approximate "
+                      "1/1) configures the single-device chunked backend "
+                      "only; sharded runs bound 1/1 memory via planned "
+                      "capacities + dep-slice streaming passes "
+                      "(RDFIND_PAIR_ROW_BUDGET), and their distributed "
+                      "two-round count-min cut is the env knob "
+                      "RDFIND_SHARDED_HALF_APPROX=1 (bit-identical output; "
+                      "see the README design note)", file=sys.stderr)
             if cfg.balanced_11:
                 print("note: --balanced-overlap-candidates is single-device "
                       "only; the sharded 1/1 already splits emission across "

@@ -1,0 +1,125 @@
+"""Sharded half-approximate 1/1 parity gate (RDFIND_SHARDED_HALF_APPROX).
+
+Tiny planted workload on the CPU proxy (8 fake devices): the sharded S2L
+and Approximate strategies must produce bit-identical CIND rows with the
+two-round count-min cut on vs off, at mesh 8 flat AND under the 2-host
+hierarchical sketch reduction — where the ledger must also show the
+factor-`local` DCN byte reduction of the hierarchical all-reduce.  The
+device-side saturating reduction is differentially checked against host
+`merge_count_min` at saturation on the way.  scripts/verify.sh runs this
+next to kernel_rung_parity; VERIFY_SKIP_HALF_APPROX=1 opts out.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _set(name, value):
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = value
+
+
+def main() -> int:
+    from rdfind_tpu.models import sharded
+    from rdfind_tpu.ops import sketch
+    from rdfind_tpu.parallel import exchange
+    from rdfind_tpu.parallel.mesh import make_mesh
+    from rdfind_tpu.utils.synth import generate_planted_cinds
+
+    for var in ("RDFIND_SHARDED_HALF_APPROX", "RDFIND_SHARDED_HA_BITS",
+                "RDFIND_HIER_HOSTS", "RDFIND_HIER_EXCHANGE"):
+        _set(var, None)
+
+    failures = []
+    mesh = make_mesh(8)
+    triples, _ = generate_planted_cinds(6, 8, seed=3)
+
+    # --- Saturation differential: device reduce vs host merge at the cap.
+    rng = np.random.default_rng(0)
+    cap = sketch.MAX_COUNT_MIN_CAP
+    parts = [np.asarray(sketch.count_min_partial(
+        rng.integers(0, 40, 200).astype(np.int32),
+        rng.integers(cap // 3, cap // 2, 200).astype(np.int32),
+        np.ones(200, bool), bits=256, num_hashes=2)) for _ in range(8)]
+    ref_tbl = sketch.merge_count_min(parts)
+    import functools
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from rdfind_tpu.parallel.mesh import AXIS, shard_map
+    for hier in (None, (2, 4)):
+        fn = functools.partial(exchange.sketch_allreduce, axis_name=AXIS,
+                               cap=cap, hier=hier)
+        got = np.asarray(jax.jit(shard_map(
+            lambda t: fn(jnp.reshape(t, (-1,))), mesh=mesh,
+            in_specs=(P(AXIS),), out_specs=P(AXIS),
+            check_vma=False))(np.stack(parts))).reshape(8, -1)[0]
+        if not np.array_equal(ref_tbl, got):
+            failures.append(f"sketch_allreduce(hier={hier}) != "
+                            "merge_count_min at saturation")
+
+    # --- Bit-identity: knob on vs off, both strategies, flat and 2-host.
+    strategies = [("s2l", sharded.discover_sharded_s2l),
+                  ("approx", sharded.discover_sharded_approx)]
+    n_rows = {}
+    for name, fn in strategies:
+        _set("RDFIND_SHARDED_HALF_APPROX", None)
+        ref = fn(triples, 2, mesh=mesh).to_rows()
+        n_rows[name] = len(ref)
+        if not ref:
+            failures.append(f"{name}: planted workload produced 0 CINDs "
+                            "(gate is vacuous)")
+        _set("RDFIND_SHARDED_HALF_APPROX", "1")
+        stats = {}
+        if fn(triples, 2, mesh=mesh, stats=stats).to_rows() != ref:
+            failures.append(f"{name}: knob-on output differs at mesh 8")
+        if stats.get("ha_build_rounds", 0) < 1:
+            failures.append(f"{name}: knob on but no sketch build ran")
+
+    # --- Hierarchical reduce: same rows, measurably fewer DCN bytes.
+    _set("RDFIND_SHARDED_HALF_APPROX", "1")
+    _set("RDFIND_HIER_HOSTS", "2")
+    ref = None
+    dcn = {}
+    for mode in ("0", "1"):
+        _set("RDFIND_HIER_EXCHANGE", mode)
+        stats = {}
+        rows = sharded.discover_sharded_s2l(triples, 2, mesh=mesh,
+                                            stats=stats).to_rows()
+        if ref is None:
+            ref = rows
+        elif rows != ref:
+            failures.append("hier sketch reduce changed the output")
+        site = stats.get("exchange_sites", {}).get(
+            exchange.SKETCH_ALLREDUCE_SITE, {})
+        dcn[mode] = site.get("dcn_bytes", -1)
+    if not (0 <= dcn["1"] < dcn["0"]):
+        failures.append(f"hier sketch reduce did not cut DCN bytes "
+                        f"(flat={dcn['0']}, hier={dcn['1']})")
+
+    if failures:
+        for f in failures:
+            print(f"half_approx_parity: {f}", file=sys.stderr)
+        return 1
+    print(f"half_approx_parity: OK — {n_rows} CIND rows bit-identical with "
+          f"the two-round cut on/off (mesh 8 flat + 2-host hier), sketch "
+          f"DCN bytes {dcn['0']} -> {dcn['1']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

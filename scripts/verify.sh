@@ -68,6 +68,18 @@ elif ! JAX_PLATFORMS=cpu timeout -k 10 600 python scripts/kernel_rung_parity.py;
     exit 1
 fi
 
+echo "== sharded half-approx parity (RDFIND_SHARDED_HALF_APPROX on/off) =="
+# The distributed two-round count-min cut must be bit-identical to the
+# exact path on a tiny planted workload (mesh 8 flat + 2-host hierarchical
+# sketch reduce, which must also cut DCN bytes).  The knob only moves
+# bytes, never results.  VERIFY_SKIP_HALF_APPROX=1 opts out.
+if [ "${VERIFY_SKIP_HALF_APPROX:-0}" = "1" ]; then
+    echo "verify: half-approx parity skipped (VERIFY_SKIP_HALF_APPROX=1)"
+elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/half_approx_parity.py; then
+    echo "verify: half-approx parity FAILED" >&2
+    exit 1
+fi
+
 if [ "${VERIFY_SKIP_BENCH:-0}" = "1" ]; then
     echo "verify: tier-1 green; bench + sentinel skipped (VERIFY_SKIP_BENCH=1)"
     exit 0

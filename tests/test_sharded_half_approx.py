@@ -1,0 +1,206 @@
+"""The sharded half-approximate 1/1 (RDFIND_SHARDED_HALF_APPROX).
+
+The distributed two-round's whole contract is *bit-identical CIND output*:
+round 1's all-reduced count-min table upper-bounds every pair's global
+co-occurrence, so the round-2 cut only drops pairs the support filter
+discards anyway.  These tests pin the bit-identity matrix (knob on/off x
+strategy x mesh size, planted workloads), the hierarchical sketch-reduce
+parity and DCN byte split on the 2-host proxy, the observability surface,
+and a chaos case proving the degradation ladder survives overflow injected
+into the round-2 verification exchange with the knob on.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from rdfind_tpu.models import allatonce, sharded
+from rdfind_tpu.parallel import exchange
+from rdfind_tpu.parallel.mesh import make_mesh
+from rdfind_tpu.runtime import faults
+from rdfind_tpu.utils.synth import generate_planted_cinds, generate_triples
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 CPU devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(1)
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv("RDFIND_SHARDED_HALF_APPROX", raising=False)
+    monkeypatch.delenv("RDFIND_SHARDED_HA_BITS", raising=False)
+    monkeypatch.delenv("RDFIND_FAULTS", raising=False)
+    monkeypatch.setenv("RDFIND_BACKOFF_BASE_MS", "1")
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _planted():
+    triples, _ = generate_planted_cinds(6, 8, seed=3)
+    return triples
+
+
+_REF_CACHE: dict = {}
+
+
+def _planted_ref(fn, mesh, key):
+    """Knob-off reference rows for the planted workload, computed once per
+    (strategy, mesh size) — many tests below compare against the same
+    baseline, and each sharded discover costs a cold XLA compile."""
+    if key not in _REF_CACHE:
+        _REF_CACHE[key] = fn(_planted(), 2, mesh=mesh).to_rows()
+    return _REF_CACHE[key]
+
+
+STRATEGIES = [
+    ("s2l", sharded.discover_sharded_s2l),
+    ("approx", sharded.discover_sharded_approx),
+]
+
+
+def test_knob_resolution(monkeypatch):
+    assert not sharded.sharded_half_approx_enabled()  # auto = off
+    monkeypatch.setenv("RDFIND_SHARDED_HALF_APPROX", "0")
+    assert not sharded.sharded_half_approx_enabled()
+    monkeypatch.setenv("RDFIND_SHARDED_HALF_APPROX", "1")
+    assert sharded.sharded_half_approx_enabled()
+    monkeypatch.setenv("RDFIND_SHARDED_HA_BITS", "1000")
+    assert sharded.sharded_ha_bits() == 1024  # pow2-rounded
+    monkeypatch.setenv("RDFIND_SHARDED_HA_BITS", "7")
+    assert sharded.sharded_ha_bits() == 32  # floor
+
+
+@pytest.mark.parametrize("name,fn", STRATEGIES)
+@pytest.mark.parametrize("mesh_name", ["mesh1", "mesh8"])
+def test_bit_identity_matrix(request, monkeypatch, name, fn, mesh_name):
+    """CIND output bit-identical with the knob on vs off, strategies 2/3 and
+    S2L, mesh {1, 8}, planted-CIND workload."""
+    mesh = request.getfixturevalue(mesh_name)
+    ref = _planted_ref(fn, mesh, (name, mesh_name))
+    monkeypatch.setenv("RDFIND_SHARDED_HALF_APPROX", "1")
+    got = fn(_planted(), 2, mesh=mesh).to_rows()
+    assert got == ref
+    assert len(ref) > 0, "planted fixture must produce CINDs"
+
+
+def test_cut_fires_and_stats_publish(mesh8, monkeypatch):
+    """On a workload with many sub-support pairs the cut must actually drop
+    rows, and the ha_* stats + sketch_allreduce ledger site must appear."""
+    triples = generate_triples(400, seed=21, n_predicates=8, n_entities=32)
+    ref = sharded.discover_sharded_s2l(triples, 3, mesh=mesh8).to_rows()
+    monkeypatch.setenv("RDFIND_SHARDED_HALF_APPROX", "1")
+    stats: dict = {}
+    got = sharded.discover_sharded_s2l(triples, 3, mesh=mesh8,
+                                       stats=stats).to_rows()
+    assert got == ref
+    assert stats["ha_cut_pairs"] > 0
+    assert stats["ha_build_rounds"] > 0
+    assert stats["ha_sketch_bits"] == sharded.sharded_ha_bits()
+    site = stats["exchange_sites"][exchange.SKETCH_ALLREDUCE_SITE]
+    assert site["calls"] == stats["ha_build_rounds"]
+    assert site["bytes"] > 0
+
+
+def test_knob_off_leaves_no_trace(mesh8):
+    """knob=0 reproduces today's round exactly: no ha stats, no sketch
+    all-reduce ledger entry (the fingerprint-stability proxy — the off path
+    dispatches the very programs it always did)."""
+    stats: dict = {}
+    sharded.discover_sharded_s2l(_planted(), 2, mesh=mesh8, stats=stats)
+    assert "ha_cut_pairs" not in stats
+    assert "ha_build_rounds" not in stats
+    assert exchange.SKETCH_ALLREDUCE_SITE not in stats.get(
+        "exchange_sites", {})
+
+
+def test_hier_sketch_reduce_parity_and_dcn_split(mesh8, monkeypatch):
+    """2-host proxy: bit-identical output, and the hierarchical sketch
+    reduction ledgers factor-`local` fewer DCN bytes than the flat
+    all-reduce of the same tables."""
+    triples = _planted()
+    ref = _planted_ref(sharded.discover_sharded_s2l, mesh8, ("s2l", "mesh8"))
+    monkeypatch.setenv("RDFIND_SHARDED_HALF_APPROX", "1")
+    monkeypatch.setenv("RDFIND_HIER_HOSTS", "2")
+
+    monkeypatch.setenv("RDFIND_HIER_EXCHANGE", "0")  # flat reduce
+    flat_stats: dict = {}
+    flat = sharded.discover_sharded_s2l(triples, 2, mesh=mesh8,
+                                        stats=flat_stats).to_rows()
+    monkeypatch.setenv("RDFIND_HIER_EXCHANGE", "1")  # hierarchical reduce
+    hier_stats: dict = {}
+    hier = sharded.discover_sharded_s2l(triples, 2, mesh=mesh8,
+                                        stats=hier_stats).to_rows()
+    assert flat == ref and hier == ref
+
+    f = flat_stats["exchange_sites"][exchange.SKETCH_ALLREDUCE_SITE]
+    h = hier_stats["exchange_sites"][exchange.SKETCH_ALLREDUCE_SITE]
+    assert f["hier"] == 0 and h["hier"] == 1
+    assert f["calls"] == h["calls"] and f["ici_bytes"] == h["ici_bytes"]
+    # d=8, hosts=2, local=4: flat DCN = d*(d-local)*B, hier = d*(hosts-1)*B.
+    assert f["dcn_bytes"] == 4 * h["dcn_bytes"] > 0
+
+
+@pytest.mark.parametrize("hosts", [
+    "1",
+    pytest.param("2", marks=pytest.mark.slow),
+    pytest.param("4", marks=pytest.mark.slow),
+    "8",
+])
+def test_factorization_fuzz(mesh8, monkeypatch, hosts):
+    """Output invariant across every (hosts x local) factorization of the
+    sketch reduction, incl. the degenerate 1xN and Nx1.  The middle
+    factorizations ride the slow tier (each is a fresh compile on the
+    one-core proxy): the device-level reduce is fuzzed across all four in
+    test_sketch_saturation, and hosts=2 end-to-end is the parity test
+    above."""
+    ref = _planted_ref(sharded.discover_sharded_s2l, mesh8, ("s2l", "mesh8"))
+    monkeypatch.setenv("RDFIND_SHARDED_HALF_APPROX", "1")
+    monkeypatch.setenv("RDFIND_HIER_HOSTS", hosts)
+    monkeypatch.setenv("RDFIND_HIER_EXCHANGE", "1")
+    got = sharded.discover_sharded_s2l(_planted(), 2, mesh=mesh8).to_rows()
+    assert got == ref
+
+
+def test_tiny_sketch_still_exact(mesh8, monkeypatch):
+    """A 32-counter table collides constantly; collisions only weaken the
+    cut, never the output (the conservativeness half of the contract)."""
+    ref = _planted_ref(sharded.discover_sharded_s2l, mesh8, ("s2l", "mesh8"))
+    monkeypatch.setenv("RDFIND_SHARDED_HALF_APPROX", "1")
+    monkeypatch.setenv("RDFIND_SHARDED_HA_BITS", "32")
+    got = sharded.discover_sharded_s2l(_planted(), 2, mesh=mesh8).to_rows()
+    assert got == ref
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_ladder_survives_overflow_with_knob_on(mesh8, monkeypatch):
+    """Chaos tier: persistent overflow injected into the round-2
+    verification exchange with the knob on.  The ladder (grow -> split ->
+    fallback-to-single-device-twin) must survive the new path and still
+    produce the exact CIND set."""
+    triples = generate_triples(300, seed=21, n_predicates=8, n_entities=32)
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    monkeypatch.setenv("RDFIND_MAX_PASS_SPLITS", "1")
+    from rdfind_tpu.models import small_to_large
+    ref = small_to_large.discover(triples, 2)
+
+    monkeypatch.setenv("RDFIND_SHARDED_HALF_APPROX", "1")
+    monkeypatch.setenv("RDFIND_FAULTS", "overflow@cooc:times=-1")
+    faults.reset()
+    stats: dict = {}
+    table = sharded.discover_sharded_s2l(triples, 2, mesh=mesh8,
+                                         max_retries=2, stats=stats)
+    actions = [d["action"] for d in stats["degradations"]]
+    assert "grow" in actions
+    assert "split" in actions
+    assert actions[-1] == "fallback"
+    assert table.to_rows() == ref.to_rows()

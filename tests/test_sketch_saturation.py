@@ -1,0 +1,148 @@
+"""The count-min saturation contract (sharded half-approximate 1/1, round 1).
+
+`merge_count_min` (host: int64 sum of partial tables, cap ONCE) is the
+reference semantics; `exchange.sketch_allreduce` (device: saturating psum,
+cap after EVERY reduction level) is the wire implementation.  The saturation
+lemma in ops/sketch.py says they agree bit-for-bit whenever every input is
+already <= cap — which `count_min_add`/`count_min_partial` guarantee.  These
+tests pin that contract at and past MAX_COUNT_MIN_CAP, the int32 overflow
+edge of the chunked accumulation, the partial-build fold, the hierarchical
+factorizations (incl. 1xN / Nx1), and the ledger byte model.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from rdfind_tpu.ops import sketch
+from rdfind_tpu.parallel import exchange
+from rdfind_tpu.parallel.mesh import AXIS, make_mesh, shard_map
+
+D = 8
+BITS = 256
+K = 2
+CAP = sketch.MAX_COUNT_MIN_CAP
+FACTORIZATIONS = [(1, 8), (2, 4), (4, 2), (8, 1)]
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 CPU devices"
+    return make_mesh(8)
+
+
+def _partials(seed, n_rows=200, lo=1, hi=50, cap=CAP):
+    """D per-device partial tables via the production build entry point."""
+    rng = np.random.default_rng(seed)
+    parts = []
+    for _ in range(D):
+        keys = jnp.asarray(rng.integers(0, 40, n_rows), jnp.int32)
+        cnts = jnp.asarray(rng.integers(lo, hi, n_rows), jnp.int32)
+        valid = jnp.asarray(rng.random(n_rows) < 0.9)
+        parts.append(np.asarray(sketch.count_min_partial(
+            keys, cnts, valid, bits=BITS, num_hashes=K, cap=cap)))
+    return parts
+
+
+def _device_reduce(mesh, parts, cap, hier):
+    def f(t):
+        return exchange.sketch_allreduce(t.reshape(-1), AXIS, cap=cap,
+                                         hier=hier)
+    sm = shard_map(f, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+                   check_vma=False)
+    out = np.asarray(jax.jit(sm)(np.stack(parts))).reshape(D, -1)
+    # Every device must hold the same reduced table (all-reduce contract).
+    for d in range(1, D):
+        np.testing.assert_array_equal(out[0], out[d])
+    return out[0]
+
+
+@pytest.mark.parametrize("hier", [None] + FACTORIZATIONS)
+def test_device_reduce_matches_host_merge(mesh8, hier):
+    """Below saturation: psum-per-level == sum-then-cap, every factorization."""
+    parts = _partials(seed=0)
+    ref = sketch.merge_count_min(parts, cap=CAP)
+    got = _device_reduce(mesh8, parts, CAP, hier)
+    np.testing.assert_array_equal(ref, got)
+
+
+@pytest.mark.parametrize("hier", [None] + FACTORIZATIONS)
+def test_agreement_at_and_past_cap(mesh8, hier):
+    """Partials hot enough that sums cross MAX_COUNT_MIN_CAP mid-reduction:
+    intermediate caps (device) vs one final cap (host) must still agree —
+    the saturation lemma's actual content."""
+    parts = _partials(seed=1, n_rows=400, lo=CAP // 3, hi=CAP // 2)
+    assert max(int(p.max()) for p in parts) == CAP, "fixture must saturate"
+    ref = sketch.merge_count_min(parts, cap=CAP)
+    assert int(ref.max()) == CAP
+    got = _device_reduce(mesh8, parts, CAP, hier)
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_small_cap_agreement(mesh8):
+    """A small cap saturates at a different level on different devices; the
+    contract is cap-generic, not MAX_COUNT_MIN_CAP-specific."""
+    parts = _partials(seed=2, cap=100)
+    ref = sketch.merge_count_min(parts, cap=100)
+    got = _device_reduce(mesh8, parts, 100, (2, 4))
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_count_min_add_chunk_accumulation_no_wrap():
+    """The int-dtype overflow edge: a full 2^14-row scan chunk of rows all
+    at the per-row clip bound accumulates 2^14 * (2^16-1) ~ 2^30 in int32
+    before the inter-chunk clamp — near, but provably below, wrap.  The
+    result must be exactly cap, not a wrapped negative."""
+    n = sketch._CM_CHUNK + 7  # spill into a second chunk too
+    t = sketch.count_min_add(
+        jnp.zeros(n, jnp.int32), jnp.full(n, CAP, jnp.int32),
+        jnp.ones(n, bool), bits=32, num_hashes=1, cap=CAP)
+    t = np.asarray(t)
+    assert (t >= 0).all()
+    assert int(t.max()) == CAP
+
+
+def test_count_min_partial_fold_is_saturating():
+    """count_min_partial(table=prev) == min(prev + partial, cap), and folding
+    order never matters (associativity under the lemma)."""
+    rng = np.random.default_rng(3)
+    keys = jnp.asarray(rng.integers(0, 30, 100), jnp.int32)
+    cnts = jnp.asarray(rng.integers(1, CAP // 2, 100), jnp.int32)
+    valid = jnp.ones(100, bool)
+    part = sketch.count_min_partial(keys, cnts, valid, bits=BITS, num_hashes=K)
+    prev = jnp.asarray(np.full(BITS, CAP - 10, np.int32))
+    folded = np.asarray(sketch.count_min_partial(
+        keys, cnts, valid, bits=BITS, num_hashes=K, table=prev))
+    ref = np.minimum(np.asarray(prev, np.int64) + np.asarray(part, np.int64),
+                     CAP).astype(np.int32)
+    np.testing.assert_array_equal(folded, ref)
+
+
+def test_sketch_allreduce_byte_model():
+    """Ledger pin: flat moves d*(d-local) tables across DCN, hierarchical
+    d*(hosts-1) — a factor-local reduction (4x at d=8, hosts=2)."""
+    b = BITS * 4
+    ici_f, dcn_f = exchange.sketch_allreduce_bytes(8, BITS, hosts=2,
+                                                   hier=False)
+    ici_h, dcn_h = exchange.sketch_allreduce_bytes(8, BITS, hosts=2,
+                                                   hier=True)
+    assert ici_f == ici_h == 8 * 3 * b
+    assert dcn_f == 8 * 4 * b and dcn_h == 8 * 1 * b
+    assert dcn_f == 4 * dcn_h
+    # Degenerate single-host: no DCN either way.
+    assert exchange.sketch_allreduce_bytes(8, BITS, hosts=1, hier=True)[1] == 0
+    assert exchange.sketch_allreduce_bytes(8, BITS, hosts=1, hier=False)[1] == 0
+
+
+def test_log_sketch_allreduce_ledger_entry():
+    stats = {}
+    part = exchange.log_sketch_allreduce(stats, num_dev=8, bits=BITS,
+                                         hosts=2, hier=True)
+    e = stats["exchange_sites"][exchange.SKETCH_ALLREDUCE_SITE]
+    assert e["calls"] == 1 and e["capacity"] == BITS and e["hier"] == 1
+    assert e["ici_bytes"] == part["ici"] and e["dcn_bytes"] == part["dcn"]
+    assert e["bytes"] == part["bytes"] == part["ici"] + part["dcn"]
+    assert part["reply"] == 0
