@@ -996,6 +996,47 @@ class _SkewMeter:
         })
 
 
+def _host_mix32(x: np.ndarray) -> np.ndarray:
+    """numpy replica of ops.hashing.mix32 (uint32 wraparound semantics)."""
+    with np.errstate(over="ignore"):
+        x = np.asarray(x).astype(np.uint32)
+        x = (x ^ (x >> np.uint32(16))) * np.uint32(0x85EBCA6B)
+        x = (x ^ (x >> np.uint32(13))) * np.uint32(0xC2B2AE35)
+        return x ^ (x >> np.uint32(16))
+
+
+def _host_bucket_of(cols, num_buckets: int, *, seed: int) -> np.ndarray:
+    """numpy replica of ops.hashing.bucket_of: the elastic-resume re-shard
+    must route reloaded rows to exactly the owners the device exchanges
+    would pick, or a resumed run would diverge from an uninterrupted one."""
+    with np.errstate(over="ignore"):
+        h = np.uint32(0x9E3779B9 * (seed + 1) & 0xFFFFFFFF)
+        for c in cols:
+            h = _host_mix32(np.asarray(c).astype(np.uint32)
+                            ^ (h + np.uint32(0x9E3779B9)))
+        return (h % np.uint32(num_buckets)).astype(np.int32)
+
+
+def _reshard_pass_rows(cols, num_dev: int):
+    """Re-shard one committed pass's host rows for a `num_dev` mesh.
+
+    collect_blocks concatenates per-device blocks in device order, and each
+    device's rows leave masked_unique sorted ascending over the 6 key
+    columns — so an uninterrupted run's global row order per pass is (owner
+    bucket, key lex).  The owner bucket is the exchange-C route,
+    bucket_of(key[0:3], num_dev, seed=_SEED_CAPTURE); recomputing it for the
+    new mesh and re-sorting reproduces bit-exactly the rows a run AT that
+    mesh size would have committed for this pass.
+    """
+    key = [np.asarray(c) for c in cols[:6]]
+    bucket = _host_bucket_of(key[0:3], num_dev, seed=_SEED_CAPTURE)
+    # np.lexsort sorts by the LAST key first: bucket is primary, then the
+    # 6 key columns major-to-minor — the same order segments.lexsort yields
+    # on device within each bucket.
+    order = np.lexsort(tuple(reversed(key)) + (bucket,))
+    return [np.asarray(c)[order] for c in cols]
+
+
 class _Pipeline:
     """Planned, retrying execution of the sharded programs (host side).
 
@@ -1156,6 +1197,11 @@ class _Pipeline:
                                     PAIR_ROW_BUDGET))
         full_load = int(plan[2]) + 2 * int(plan[4])
         self.n_pass = max(1, -(-full_load // budget))
+        # Plan maxima stashed for elastic resume: adopting a snapshot's pass
+        # count (_adopt_n_pass) re-derives the per-pass caps from these same
+        # measured numbers rather than fingerprinting mesh-sized state.
+        self._plan_pairs = int(plan[2])
+        self._plan_giant_pairs = int(plan[4])
         self.cap_p = _headroom(int(plan[2]) // self.n_pass, floor=1 << 10)
         self.cap_g = _headroom(plan[3])
         self.cap_gp = _headroom(2 * int(plan[4]) // self.n_pass,
@@ -1439,6 +1485,115 @@ class _Pipeline:
                         exchange_c_dcn=self.hosts * self.cap_c_dcn)
         _check_caps(**caps)
 
+    def _adopt_n_pass(self, n_pass: int) -> None:
+        """Re-derive the per-pass capacity plan for a snapshot's pass count.
+
+        The caps come from the stashed plan maxima through the exact
+        formulas __init__ used, so adoption reproduces the plan a fresh run
+        at this n_pass would compute — grown/split state never leaks into a
+        resumed attempt (cap doctrine: clean-pass output is
+        capacity-independent)."""
+        if int(n_pass) == self.n_pass:
+            return
+        self.n_pass = int(n_pass)
+        self.cap_p = _headroom(self._plan_pairs // self.n_pass,
+                               floor=1 << 10)
+        self.cap_gp = _headroom(2 * self._plan_giant_pairs // self.n_pass,
+                                floor=1 << 10)
+        self.cap_c = _headroom((self.cap_p + self.cap_gp)
+                               // max(self.num_dev, 1), floor=1 << 10)
+        if self.hier is not None:
+            self.cap_c_dcn = _headroom(
+                self.hier[1] * ((self.cap_p + self.cap_gp)
+                                // max(self.num_dev, 1)) // 2,
+                floor=1 << 10)
+        self._check_pair_caps()
+        if self.stats is not None:
+            metrics.gauge_set(self.stats, "n_pair_passes", self.n_pass)
+
+    def _note_resume(self, *, vote_rounds=0, resharded_blocks=0,
+                     resharded_bytes=0, **fields):
+        """Accumulate elastic-resume lineage into the `elastic_resume`
+        struct (count keys sum across phases, identity keys overwrite); the
+        metrics shim mirrors it to the registry for Prometheus export."""
+        if self.stats is None:
+            return
+        cur = self.stats.get("elastic_resume") or {}
+        fields.update(
+            to_num_dev=self.num_dev,
+            vote_rounds=int(cur.get("vote_rounds", 0)) + int(vote_rounds),
+            resharded_blocks=(int(cur.get("resharded_blocks", 0))
+                              + int(resharded_blocks)),
+            resharded_bytes=(int(cur.get("resharded_bytes", 0))
+                             + int(resharded_bytes)))
+        metrics.struct_update(self.stats, "elastic_resume", **fields)
+
+    def _resolve_resume(self, snap, *, allow_adopt: bool) -> dict:
+        """The per-phase resume decision: which committed passes to skip,
+        under which pass count (possibly adopted from the snapshot).
+
+        Single-process this is a local decision.  Multi-process it is the
+        all-hosts-agree vote: round 1 allgathers (has-snapshot, stored
+        n_pass) and picks a candidate partition only if every snapshot
+        holder stored the same one; round 2 allgathers per-pass committed
+        bitmaps under that partition and intersects them.  Every host
+        derives the identical resume set from identical allgathered state,
+        so no host can skip its half of a collective and deadlock the mesh;
+        a host with a torn/missing/stale snapshot just contributes zeros and
+        shrinks the intersection (coarser resume, same results).
+
+        `allow_adopt` is False after a split rung re-partitioned the phase
+        mid-run: the snapshot's n_pass then no longer matches what THIS
+        attempt must produce, and adoption would undo the split.
+
+        Returns {pass_idx: (blocks, tele)} — empty means full re-run."""
+        has = (snap is not None and bool(snap.parts) and snap.n_pass > 0
+               and snap.num_dev > 0)
+        if jax.process_count() == 1:
+            if not has:
+                return {}
+            if snap.n_pass != self.n_pass:
+                if not allow_adopt:
+                    return {}
+                self._adopt_n_pass(snap.n_pass)
+                self._note_resume(adopted_n_pass=self.n_pass)
+            return dict(snap.parts)
+        # Round 1: (has, stored n_pass).  Hosts must agree on the partition
+        # BEFORE exchanging bitmaps, or the bitmap lengths would diverge.
+        votes = allgather_host_values(
+            [1.0 if has else 0.0, float(snap.n_pass if has else 0)])
+        holders = votes[votes[:, 0] > 0]
+        if holders.shape[0] == 0:
+            self._note_resume(vote_rounds=1)
+            return {}
+        stored = {int(v) for v in holders[:, 1]}
+        if len(stored) != 1:
+            # Snapshot holders disagree on the partition (one host's file
+            # predates a split rung): no pass can be common to all of them.
+            self._note_resume(vote_rounds=1)
+            return {}
+        cand = stored.pop()
+        if cand != self.n_pass and not allow_adopt:
+            self._note_resume(vote_rounds=1)
+            return {}
+        # Round 2: committed-pass bitmaps under the agreed partition.
+        bitmap = np.zeros(cand, np.float64)
+        if has and snap.n_pass == cand:
+            for p in snap.parts:
+                if 0 <= p < cand:
+                    bitmap[p] = 1.0
+        common = allgather_host_values(bitmap).min(axis=0)
+        self._note_resume(vote_rounds=2)
+        passes = [p for p in range(cand) if common[p] > 0]
+        if not passes:
+            return {}
+        # A non-empty intersection proves every host holds these passes, so
+        # snap.parts is present and covers them on this host too.
+        if cand != self.n_pass:
+            self._adopt_n_pass(cand)
+            self._note_resume(adopted_n_pass=self.n_pass)
+        return {p: snap.parts[p] for p in passes}
+
     def collect_blocks(self, cols, n_out):
         """Per-device compacted outputs -> host rows (ONE batched pull)."""
         *cols_h, n_out_h = host_gather_many(list(cols) + [n_out])
@@ -1482,7 +1637,8 @@ class _Pipeline:
 
     def _run_passes(self, step, what: str, *, site: str = "cind",
                     phase_key: str | None = None, fp_extra=None,
-                    ledger_sites=("exchange_c", "giant_gather")):
+                    ledger_sites=("exchange_c", "giant_gather"),
+                    block_layout: str = "rows"):
         """Pipelined dep-slice pass executor — the shared scaffolding of
         run_cinds and run_cooc.  `step(pass_args)` must return device arrays
         (cols, n_out, telemetry) with telemetry an exchange.pack_counters
@@ -1501,7 +1657,11 @@ class _Pipeline:
           * with a ProgressStore attached, each committed pass's host blocks
             are snapshotted asynchronously (atomic + fsynced off the
             critical path) and a preempted run's successor replays only the
-            unfinished passes (stats["resumed_passes"]).
+            unfinished passes (stats["resumed_passes"]).  Snapshots are
+            mesh-portable: the fingerprint is num_dev-free, blocks are
+            re-sharded on load (_reshard_pass_rows), the stored n_pass may
+            be adopted, and multi-host runs agree on the resume set through
+            _resolve_resume's allgather vote before any host skips a pass.
 
         Schedule: pass p+1's jitted step is enqueued as soon as pass p's is
         (up to dispatch.pass_depth() passes in flight), the packed telemetry
@@ -1533,7 +1693,9 @@ class _Pipeline:
         while True:
             try:
                 return self._attempt_passes(step, what, site, phase_key, seq,
-                                            fp_extra, ledger_sites)
+                                            fp_extra, ledger_sites,
+                                            block_layout=block_layout,
+                                            allow_adopt=(n_splits == 0))
             except _PairCapsExhausted as e:
                 if faults.strict_mode():
                     raise RuntimeError(e.msg) from None
@@ -1567,15 +1729,52 @@ class _Pipeline:
                 raise faults.FallbackRequired(what, e.msg) from None
 
     def _attempt_passes(self, step, what, site, phase_key, seq, fp_extra,
-                        ledger_sites=("exchange_c", "giant_gather")):
+                        ledger_sites=("exchange_c", "giant_gather"), *,
+                        block_layout="rows", allow_adopt=True):
         """One ladder attempt of the pipelined pass loop at the current
         n_pass/caps (see _run_passes for the schedule contract)."""
         d = dispatch.DispatchStats(pull_base=self._pull_base)
         t_attempt = time.perf_counter()
         meter = _SkewMeter(self.stats, what)
+        stage = fp = None
+        resumed = {}
+        # Elastic resume: the phase fingerprint is mesh-independent (what
+        # the pass PRODUCES), the snapshot meta carries how it was
+        # partitioned (num_dev, n_pass), and multi-host runs agree on the
+        # resume set through _resolve_resume's vote before any host skips a
+        # collective.  Every host must attach a ProgressStore under the same
+        # checkpoint config or none may (same contract as RDFIND_TRACE).
+        progress = self.progress
+        if progress is not None:
+            stage, fp = progress.phase_fp(
+                phase_key, seq,
+                extra=dict(what=what, min_support=int(self.min_support),
+                           **(fp_extra or {})))
+            snap = progress.load(stage, fp)
+            resumed = self._resolve_resume(snap, allow_adopt=allow_adopt)
+            if resumed and snap.num_dev != self.num_dev:
+                if block_layout == "rows":
+                    nbytes = sum(np.asarray(b).nbytes
+                                 for blocks_p, _ in resumed.values()
+                                 for b in blocks_p)
+                    resumed = {
+                        p: (_reshard_pass_rows(blocks_p, self.num_dev),
+                            tele_p)
+                        for p, (blocks_p, tele_p) in resumed.items()}
+                    self._note_resume(from_num_dev=int(snap.num_dev),
+                                      resharded_blocks=len(resumed),
+                                      resharded_bytes=nbytes)
+                else:
+                    # Sketch layout: per-device count-min partials fold
+                    # through a saturating add, which is grouping-insensitive
+                    # (saturation lemma) — no re-routing needed, the
+                    # mesh-agnostic fold in _ha_build_table absorbs any
+                    # device count.
+                    self._note_resume(from_num_dev=int(snap.num_dev))
         # Cap-exhaustion forecaster (obs/forecast.py): fed each committed
         # pass's utilization fractions, it names the cap and predicted pass
-        # BEFORE the grow/split rungs fire.  Resolved once per attempt.
+        # BEFORE the grow/split rungs fire.  Resolved once per attempt,
+        # AFTER resume resolution may have adopted the snapshot's n_pass.
         fc = (forecast.Forecaster(self.stats, self.n_pass, phase=what)
               if self.stats is not None and forecast.enabled() else None)
         # Phase clock: zero-cost no-op unless a skew consumer is live.
@@ -1583,28 +1782,17 @@ class _Pipeline:
         parts = [None] * self.n_pass
         teles = [None] * self.n_pass
         tries = [0] * self.n_pass
-        stage = fp = None
-        # Single-process only: resuming a pass from a host-local snapshot
-        # while a peer host misses it would skip this host's half of the
-        # collectives and deadlock the mesh (the discover-stage resume
-        # solves this with an all-hosts-agree vote; per-pass agreement is
-        # future work, so multi-host runs keep stage-boundary resume only).
-        progress = self.progress if jax.process_count() == 1 else None
-        if progress is not None:
-            stage, fp = progress.phase_fp(
-                phase_key, seq, n_pass=self.n_pass, num_dev=self.num_dev,
-                extra=dict(what=what, min_support=int(self.min_support),
-                           caps=self._planned_caps, **(fp_extra or {})))
-            done = progress.load(stage, fp)
-            if done:
-                for p, (blocks_p, tele_p) in done.items():
-                    if 0 <= p < self.n_pass:
-                        parts[p] = list(blocks_p)
-                        teles[p] = tele_p
-                if self.stats is not None:
-                    metrics.counter_add(
-                        self.stats, "resumed_passes",
-                        sum(1 for x in parts if x is not None))
+        for p, (blocks_p, tele_p) in resumed.items():
+            if 0 <= p < self.n_pass:
+                parts[p] = [np.asarray(b) for b in blocks_p]
+                teles[p] = tuple(int(x) for x in tele_p)
+        n_res = sum(1 for x in parts if x is not None)
+        if n_res:
+            if self.stats is not None:
+                metrics.counter_add(self.stats, "resumed_passes", n_res)
+            tracer.instant("elastic_resume", cat=tracer.CAT_RUN,
+                           stage=stage or "", what=what,
+                           resumed_passes=n_res, num_dev=self.num_dev)
         depth = dispatch.pass_depth()
         inflight = collections.deque()  # (p, cols, n_out, telemetry)
         p_next = 0
@@ -1726,7 +1914,8 @@ class _Pipeline:
                     # compute.
                     progress.submit(stage, fp, {
                         i: (parts[i], teles[i]) for i in range(self.n_pass)
-                        if parts[i] is not None})
+                        if parts[i] is not None},
+                        num_dev=self.num_dev, n_pass=self.n_pass)
                 if meter.active:
                     t_end = now()
                     meter.pass_committed({
@@ -1797,15 +1986,22 @@ class _Pipeline:
             step, "HA sketch build", site="cooc", phase_key=f"{stat_key}:ha1",
             fp_extra={"flags": digest,
                       "ha": [self.ha_bits, self.ha_hashes, self.ha_thresh]},
-            ledger_sites=("giant_gather",))
+            ledger_sites=("giant_gather",), block_layout="sketch")
         from ..ops import sketch
-        # blocks[0] concatenates per-pass (D*bits,) collect_blocks pulls;
-        # rearrange device-major so each device's shard_map slice holds its
-        # own per-pass partials, then fold + saturating-all-reduce on device.
-        parts = np.asarray(blocks[0], np.int32).reshape(
-            -1, self.num_dev, self.ha_bits)
-        stacked = np.ascontiguousarray(
-            parts.transpose(1, 0, 2).reshape(self.num_dev, -1))
+        # blocks[0] concatenates per-pass collect_blocks pulls of per-device
+        # (bits,) partial tables — possibly committed at a DIFFERENT mesh
+        # size (elastic resume), so the fold must not assume the row count
+        # divides by num_dev.  Treat each partial as one row, zero-pad to
+        # the mesh (zeros are the saturating fold's identity), and split the
+        # rows evenly: the saturating add is grouping-insensitive
+        # (saturation lemma, ops/sketch.py), so ANY arrangement folds to
+        # the identical min(cap, true sum) table.
+        parts = np.asarray(blocks[0], np.int32).reshape(-1, self.ha_bits)
+        pad = -parts.shape[0] % self.num_dev
+        if pad:
+            parts = np.concatenate(
+                [parts, np.zeros((pad, self.ha_bits), np.int32)])
+        stacked = np.ascontiguousarray(parts.reshape(self.num_dev, -1))
         hier_on = self.hier is not None
         pend = [exchange.log_sketch_allreduce(
             self.stats, num_dev=self.num_dev, bits=self.ha_bits,

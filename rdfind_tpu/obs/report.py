@@ -281,6 +281,19 @@ def format_debug_lines(stats: dict) -> list[str]:
             f"host_pull_retries={stats.get('n_host_pull_retries', 0)} "
             f"backoff_ms={stats.get('backoff_ms_total', 0.0):.1f} "
             f"resumed_passes={stats.get('resumed_passes', 0)}")
+    if stats.get("elastic_resume"):
+        # Resume lineage: what mesh the snapshots came from, what got
+        # re-sharded, and how the hosts agreed (models/sharded
+        # _resolve_resume + the driver's preemption supervisor).
+        er = stats["elastic_resume"]
+        lines.append(
+            f"elastic resume: from_dev={er.get('from_num_dev', '-')} "
+            f"to_dev={er.get('to_num_dev', '-')} "
+            f"resharded_blocks={er.get('resharded_blocks', 0)} "
+            f"resharded_bytes={er.get('resharded_bytes', 0)} "
+            f"vote_rounds={er.get('vote_rounds', 0)} "
+            f"adopted_n_pass={er.get('adopted_n_pass', '-')} "
+            f"supervisor_attempts={er.get('supervisor_attempts', 0)}")
     if stats.get("datastats_lines"):
         # The data plane: what the join-line / capture distributions looked
         # like (obs/datastats.py), not just what the machinery did to them.

@@ -173,6 +173,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "RDFIND_STRICT=1 fail-fast, RDFIND_FAULTS=... "
                         "deterministic fault injection (see README, 'Fault "
                         "tolerance & resume')")
+    p.add_argument("--retry-on-preempt", type=int, default=0, metavar="N",
+                   help="in-driver preemption supervisor: on Preempted (or "
+                        "an escaped fallback request), back off and re-enter "
+                        "the run up to N times, resuming from the "
+                        "mesh-portable progress snapshots — even on a "
+                        "shrunken device set.  0 (default) keeps the "
+                        "historical exit-75 behavior for an external "
+                        "orchestrator; RDFIND_RETRY_ON_PREEMPT is the env "
+                        "form (the flag wins)")
     return p
 
 
@@ -243,6 +252,7 @@ def main(argv=None) -> int:
         debug_level=args.debug_level,
         counter_level=args.counter_level,
         n_devices=args.dop,
+        retry_on_preempt=args.retry_on_preempt,
         native_ingest=not args.no_native_ingest,
         checkpoint_dir=args.checkpoint_dir,
         explicit_threshold=args.explicit_threshold,
@@ -302,8 +312,9 @@ def main(argv=None) -> int:
         # Injected (or test-driven) preemption: in-flight progress was
         # flushed before the raise; the same command resumes the run.
         print(f"rdfind: preempted ({e}); re-run with the same "
-              f"--checkpoint-dir to resume from the last committed pass",
-              file=sys.stderr)
+              f"--checkpoint-dir to resume from the last committed pass "
+              f"(or pass --retry-on-preempt N to let the driver retry "
+              f"in-process)", file=sys.stderr)
         return 75  # EX_TEMPFAIL: transient, retry the same invocation
     if not (cfg.output_file or cfg.collect_result):
         print(f"Detected {len(result.table)} CINDs.")

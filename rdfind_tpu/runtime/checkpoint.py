@@ -15,6 +15,7 @@ dictionary's strings are stored as one UTF-8 blob + offsets.
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import json
 import os
@@ -36,7 +37,10 @@ from . import faults
 # from older code can never satisfy a newer run.
 # 2: fault-domain hardening — durable (fsynced) saves, per-pass
 #    discover-progress stages, stats now carry degradation/retry telemetry.
-CHECKPOINT_FORMAT = 2
+# 3: elastic resume — progress snapshots carry (num_dev, n_pass) meta and are
+#    mesh-portable (re-sharded on load), so the mesh size left the progress
+#    fingerprints; old num_dev-keyed snapshots must be a clean miss.
+CHECKPOINT_FORMAT = 3
 
 
 def fingerprint(payload: dict) -> str:
@@ -82,7 +86,9 @@ class CheckpointStore:
 
     def _save(self, stage: str, fp: str, arrays: dict) -> None:
         faults.maybe_fail("checkpoint_write")
-        tmp = self._path(stage) + ".tmp.npz"  # .npz suffix: savez won't rename
+        # pid-unique tmp so hosts sharing one checkpoint dir never tear each
+        # other's in-flight writes; .npz suffix so savez won't re-append one.
+        tmp = self._path(stage) + f".tmp.{os.getpid()}.npz"
         np.savez(tmp, __fingerprint__=np.frombuffer(fp.encode(), np.uint8),
                  **arrays)
         # Durability before visibility: fsync the tmp file so a host crash
@@ -216,9 +222,25 @@ def flush_all_progress() -> None:
             pass  # a failed flush must never mask the signal itself
 
 
-def encode_progress(parts: dict) -> dict:
-    """{pass_idx: (host blocks, tail-counter tuple)} -> npz arrays."""
-    out = {"done": np.asarray(sorted(parts), np.int64)}
+@dataclasses.dataclass
+class ProgressSnapshot:
+    """One decoded per-pass progress snapshot plus the partition meta a
+    resuming run needs to adopt (n_pass) or re-shard (num_dev) it."""
+
+    parts: dict     # {pass_idx: (host blocks, tail-counter tuple)}
+    num_dev: int    # mesh size whose device order the blocks concatenate in
+    n_pass: int     # dep-slice pass count the blocks partition under
+
+
+def encode_progress(parts: dict, *, num_dev: int = 0,
+                    n_pass: int = 0) -> dict:
+    """{pass_idx: (host blocks, tail-counter tuple)} -> npz arrays.
+
+    `num_dev`/`n_pass` ride along as snapshot meta (NOT fingerprinted):
+    the loader re-shards blocks for a different mesh and may adopt the
+    stored pass count, so neither may invalidate the snapshot."""
+    out = {"done": np.asarray(sorted(parts), np.int64),
+           "meta": np.asarray([num_dev, n_pass], np.int64)}
     for p, (blocks, tele) in parts.items():
         for i, b in enumerate(blocks):
             out[f"p{p}_b{i}"] = np.asarray(b)
@@ -226,7 +248,7 @@ def encode_progress(parts: dict) -> dict:
     return out
 
 
-def decode_progress(arrays: dict) -> dict:
+def decode_progress(arrays: dict) -> ProgressSnapshot:
     out = {}
     for p in arrays.get("done", np.zeros(0, np.int64)):
         p = int(p)
@@ -234,7 +256,9 @@ def decode_progress(arrays: dict) -> dict:
         while f"p{p}_b{len(blocks)}" in arrays:
             blocks.append(arrays[f"p{p}_b{len(blocks)}"])
         out[p] = (blocks, tuple(int(x) for x in arrays[f"p{p}_tele"]))
-    return out
+    meta = arrays.get("meta", np.zeros(2, np.int64))
+    return ProgressSnapshot(parts=out, num_dev=int(meta[0]),
+                            n_pass=int(meta[1]))
 
 
 def _phase_slug(phase_key: str, seq: int) -> str:
@@ -242,80 +266,128 @@ def _phase_slug(phase_key: str, seq: int) -> str:
     return f"progress-{seq:03d}-{safe[:40]}"
 
 
+def _writer_main(store_ref: "weakref.ref[ProgressStore]") -> None:
+    """The ONE long-lived snapshot writer of a ProgressStore.
+
+    Holds only a weakref between iterations so the store stays collectable
+    (the WeakSet registry must keep working); exits when the store is gone.
+    Coalescing happens in the pending map — only the newest submitted
+    snapshot per stage is ever written, so a burst of pass commits costs one
+    disk write, and an older snapshot can never overwrite a newer one."""
+    while True:
+        store = store_ref()
+        if store is None:
+            return
+        with store._cond:
+            item = store._pop_pending_locked()
+            if item is None:
+                # Nothing queued: sleep bounded so a GC'd store is noticed.
+                store._cond.wait(timeout=1.0)
+                item = store._pop_pending_locked()
+        if item is not None:
+            stage, fp, arrays = item
+            try:
+                store.store.save(stage, fp, arrays)
+            except Exception as e:
+                # A failed progress write (incl. an injected checkpoint_write
+                # fault) only coarsens resume granularity; it must never fail
+                # the run.
+                print(f"warning: progress checkpoint {stage} failed "
+                      f"({e}); resume granularity degrades, results do "
+                      f"not", file=sys.stderr)
+            with store._cond:
+                store._inflight = None
+                store._cond.notify_all()
+        del store  # drop the strong ref before the next liveness check
+
+
 class ProgressStore:
     """Preemption-safe per-pass discover checkpoints, written asynchronously.
 
     The pass executor (models/sharded._Pipeline._run_passes) submits a
-    snapshot of every committed pass's host blocks after each pass; a worker
-    thread writes it through CheckpointStore.save (atomic + fsynced) OFF the
-    critical path, so a clean pass pays only the cost of handing over numpy
-    references.  A preempted run's successor loads the snapshot and replays
-    only unfinished passes (differentially bit-identical to an uninterrupted
-    run — tests/test_faults.py).
+    snapshot of every committed pass's host blocks after each pass; ONE
+    long-lived worker thread writes the newest snapshot per stage through
+    CheckpointStore.save (atomic + fsynced) OFF the critical path, so a
+    clean pass pays only the cost of handing over numpy references and a
+    burst of commits coalesces to a single write.
 
-    Fingerprints embed the base discover fingerprint plus the phase identity,
-    n_pass, mesh size and the planned capacities — everything that shapes how
-    passes partition the work.  Grown (retry) capacities are deliberately NOT
-    fingerprinted: a clean pass's output is capacity-independent.
-    """
+    Fingerprints embed the base discover fingerprint plus the phase identity
+    — deliberately NOT n_pass, the mesh size, or any capacity: a clean
+    pass's output is capacity-independent, blocks are re-sharded on load for
+    a different mesh, and the stored pass count may be adopted.  What shapes
+    the partition rides in the snapshot itself (encode_progress meta)."""
 
     def __init__(self, store: CheckpointStore, base_fp: str):
         self.store = store
         self.base_fp = base_fp
-        self._lock = threading.Lock()
-        self._threads: list[threading.Thread] = []
+        self._cond = threading.Condition()
+        self._pending: dict = {}    # stage -> (fp, arrays), newest only
+        self._inflight: str | None = None  # stage the writer holds right now
+        self._writer: threading.Thread | None = None
         self._stages: set[str] = set()
-        self._version = 0          # submission order (main thread only)
-        self._written: dict = {}   # stage -> newest version on disk
         _PROGRESS_REGISTRY.add(self)
 
-    def phase_fp(self, phase_key: str, seq: int, *, n_pass: int, num_dev: int,
-                 extra=None) -> tuple[str, str]:
-        """(stage_name, fingerprint) of one pass-executor phase."""
+    def phase_fp(self, phase_key: str, seq: int, *, extra=None) \
+            -> tuple[str, str]:
+        """(stage_name, fingerprint) of one pass-executor phase.  The
+        fingerprint is mesh-independent by construction (elastic resume)."""
         fp = fingerprint(dict(base=self.base_fp, phase=phase_key, seq=seq,
-                              n_pass=n_pass, num_dev=num_dev, extra=extra))
+                              extra=extra))
         return _phase_slug(phase_key, seq), fp
 
-    def load(self, stage: str, fp: str) -> dict | None:
+    def load(self, stage: str, fp: str) -> ProgressSnapshot | None:
         arrays = self.store.load(stage, fp)
         if arrays is None:
             return None
         return decode_progress(arrays)
 
-    def submit(self, stage: str, fp: str, parts: dict) -> None:
-        """Write a snapshot asynchronously.  Snapshots are cumulative and
-        versioned in submission order: a worker that lost the lock race to a
-        newer snapshot skips its write, so an older (smaller) snapshot can
-        never overwrite a newer one on disk."""
-        arrays = encode_progress(parts)
+    def submit(self, stage: str, fp: str, parts: dict, *, num_dev: int = 0,
+               n_pass: int = 0) -> None:
+        """Queue a snapshot for the writer thread.  Snapshots are cumulative:
+        replacing a stage's pending entry loses nothing but an already-stale
+        intermediate state."""
+        arrays = encode_progress(parts, num_dev=num_dev, n_pass=n_pass)
         self._stages.add(stage)
-        self._version += 1
-        version = self._version
+        with self._cond:
+            self._pending[stage] = (fp, arrays)
+            if self._writer is None or not self._writer.is_alive():
+                self._writer = threading.Thread(
+                    target=_writer_main, args=(weakref.ref(self),),
+                    name="ckpt-progress-writer", daemon=True)
+                self._writer.start()
+            self._cond.notify_all()
 
-        def write():
-            with self._lock:  # serialize writers; each write is atomic anyway
-                if self._written.get(stage, 0) > version:
-                    return  # a newer snapshot already landed
-                try:
-                    self.store.save(stage, fp, arrays)
-                    self._written[stage] = version
-                except Exception as e:
-                    # A failed progress write (incl. an injected
-                    # checkpoint_write fault) only coarsens resume
-                    # granularity; it must never fail the run.
-                    print(f"warning: progress checkpoint {stage} failed "
-                          f"({e}); resume granularity degrades, results do "
-                          f"not", file=sys.stderr)
-
-        t = threading.Thread(target=write, name=f"ckpt-{stage}", daemon=True)
-        t.start()
-        self._threads.append(t)
+    def _pop_pending_locked(self):
+        """(stage, fp, arrays) of one pending snapshot, or None.  Caller
+        holds self._cond; marks the popped stage in flight so flush() keeps
+        waiting until its write lands."""
+        if not self._pending:
+            return None
+        stage, (fp, arrays) = self._pending.popitem()
+        self._inflight = stage
+        return stage, fp, arrays
 
     def flush(self) -> None:
         """Block until every submitted snapshot has landed on disk."""
-        threads, self._threads = self._threads, []
-        for t in threads:
-            t.join()
+        with self._cond:
+            while self._pending or self._inflight is not None:
+                if self._writer is None or not self._writer.is_alive():
+                    # No writer to wait for (e.g. flush from a signal handler
+                    # racing a dying interpreter): drain synchronously.
+                    item = self._pop_pending_locked()
+                    if item is None:
+                        self._inflight = None
+                        return
+                    stage, fp, arrays = item
+                    try:
+                        self.store.save(stage, fp, arrays)
+                    except Exception as e:
+                        print(f"warning: progress checkpoint {stage} failed "
+                              f"({e}); resume granularity degrades, results "
+                              f"do not", file=sys.stderr)
+                    self._inflight = None
+                    continue
+                self._cond.wait(timeout=0.1)
 
     def cleanup(self) -> None:
         """Drop all progress stages (the full discover stage supersedes

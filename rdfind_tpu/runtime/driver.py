@@ -55,6 +55,7 @@ class Config:
     debug_level: int = 0
     counter_level: int = 0
     n_devices: int = 1  # degree of parallelism (the reference's -dop)
+    retry_on_preempt: int = 0  # in-driver preemption supervisor retry budget
     native_ingest: bool = True  # C++ fused read+parse+intern when applicable
     checkpoint_dir: str | None = None  # stage-boundary checkpoints (resume)
     explicit_threshold: int = -1  # != -1: half-approximate 1/1 (strategy 1)
@@ -178,11 +179,15 @@ def _checkpoint_payloads(cfg: Config, use_native: bool):
         # to differ on degenerate inputs; a checkpoint from one must not
         # satisfy a run explicitly requesting the other.
         native=use_native)
+    # The mesh size is deliberately NOT fingerprinted (elastic resume): the
+    # CIND output is bit-identical across device counts by the sharded
+    # pipelines' contract, so a discover checkpoint from a mesh-8 run must
+    # satisfy the mesh-2 run that resumes it.
     discover_payload = dict(
         ingest=ingest_payload, min_support=cfg.min_support,
         strategy=cfg.traversal_strategy, projections=cfg.projections,
         use_fis=cfg.use_frequent_item_set, use_ars=cfg.use_association_rules,
-        clean_implied=cfg.clean_implied, n_devices=cfg.n_devices)
+        clean_implied=cfg.clean_implied)
     if _half_approx_active(cfg):
         # Only fingerprint the knobs when they actually reach the strategy —
         # a no-effect flag must not invalidate an identical-output checkpoint.
@@ -415,12 +420,18 @@ def _run_sharded_ingest(cfg: Config, phases: _Phases,
         # stays in the discover payload's embedded copy.
         cache_payload = {k: v for k, v in ingest_payload.items()
                          if k != "distinct"}
-        sharded_extra = dict(sharded=True, num_hosts=jax.process_count(),
-                             interning=cfg.interning)
+        # The host count shapes the ingest ARTIFACTS (per-host file subsets,
+        # per-host dictionary shards) but not the discover OUTPUT — so it
+        # fingerprints the ingest cache only.  Keeping it out of the discover
+        # fingerprint lets a preempted N-host run resume its committed work
+        # on a different host count (elastic resume).
+        ingest_extra = dict(sharded=True, num_hosts=jax.process_count(),
+                            interning=cfg.interning)
+        discover_extra = dict(sharded=True, interning=cfg.interning)
         ckpt = checkpoint.CheckpointStore(cfg.checkpoint_dir)
-        ingest_fp = checkpoint.fingerprint({**cache_payload, **sharded_extra})
+        ingest_fp = checkpoint.fingerprint({**cache_payload, **ingest_extra})
         discover_fp = checkpoint.fingerprint({**discover_payload,
-                                              **sharded_extra})
+                                              **discover_extra})
         progress = checkpoint.ProgressStore(ckpt, discover_fp)
 
     def ingest():
@@ -632,10 +643,78 @@ def _safe_save(ckpt: "checkpoint.CheckpointStore", stage: str, fp: str,
 def run(cfg: Config) -> RunResult:
     with _obs_session(cfg):
         with _flush_progress_on_signal(bool(cfg.checkpoint_dir)):
+            return _run_supervised(cfg)
+
+
+def _retry_budget(cfg: Config) -> int:
+    """--retry-on-preempt, with RDFIND_RETRY_ON_PREEMPT as the env fallback
+    (orchestrators set the env; the flag wins when both are given)."""
+    if cfg.retry_on_preempt > 0:
+        return cfg.retry_on_preempt
+    try:
+        return max(0, int(os.environ.get("RDFIND_RETRY_ON_PREEMPT", "0")
+                          or 0))
+    except ValueError:
+        return 0
+
+
+def _run_supervised(cfg: Config) -> RunResult:
+    """The in-driver preemption supervisor: a preempted attempt flushes its
+    progress snapshots (already done by the raising site / signal handler),
+    backs off with the fault ladder's jittered schedule, re-probes the
+    visible device set, and re-enters the run — which resumes from the
+    (possibly re-sharded) snapshots instead of starting over.  A zero budget
+    keeps the historical behavior: Preempted propagates to the CLI's exit-75
+    path for an external orchestrator to restart us."""
+    from . import faults
+
+    budget = _retry_budget(cfg)
+    attempt = 0
+    while True:
+        try:
             with tracer.span("run", cat=tracer.CAT_RUN,
                              strategy=cfg.traversal_strategy,
-                             n_devices=cfg.n_devices):
-                return _run_profiled(cfg)
+                             n_devices=cfg.n_devices, attempt=attempt):
+                out = _run_profiled(cfg)
+            if attempt:
+                out.counters["supervisor-attempts"] = attempt
+                metrics.struct_update(None, "elastic_resume",
+                                      supervisor_attempts=attempt)
+                if cfg.counter_level >= 1:
+                    # The counter report already printed inside the attempt,
+                    # before this counter existed.
+                    print(f"supervisor-attempts: {attempt}", file=sys.stderr)
+            return out
+        except (faults.Preempted, faults.FallbackRequired) as e:
+            attempt += 1
+            if attempt > budget:
+                raise
+            # Belt and braces: the raising site flushes before Preempted
+            # propagates, but a FallbackRequired that escaped the discover
+            # entry point may not have.
+            checkpoint.flush_all_progress()
+            metrics.counter_add(None, "preempt_supervisor_retries")
+            metrics.struct_update(None, "elastic_resume",
+                                  supervisor_attempts=attempt)
+            delay_ms = faults._backoff_ms(attempt - 1)
+            tracer.instant("preempt_retry", cat=tracer.CAT_RUN,
+                           attempt=attempt, budget=budget,
+                           backoff_ms=delay_ms, reason=str(e))
+            print(f"rdfind: preempted ({e}); supervisor retry "
+                  f"{attempt}/{budget} after {delay_ms} ms",
+                  file=sys.stderr, flush=True)
+            time.sleep(delay_ms / 1e3)
+            # Re-probe the device set: a restart after real preemption can
+            # come back with less capacity; the snapshots re-shard on load.
+            import jax
+            try:
+                avail = len(jax.devices())
+            except Exception:
+                avail = cfg.n_devices
+            if cfg.n_devices > avail > 0:
+                print(f"rdfind: device set shrank to {avail}; resuming "
+                      f"re-sharded", file=sys.stderr, flush=True)
+                cfg = dataclasses.replace(cfg, n_devices=avail)
 
 
 @contextlib.contextmanager

@@ -35,7 +35,7 @@ fi
 
 echo "== tier-1 test suite (ROADMAP recipe) =="
 rm -f /tmp/_t1.log
-timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+timeout -k 10 2400 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
@@ -77,6 +77,17 @@ if [ "${VERIFY_SKIP_HALF_APPROX:-0}" = "1" ]; then
     echo "verify: half-approx parity skipped (VERIFY_SKIP_HALF_APPROX=1)"
 elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/half_approx_parity.py; then
     echo "verify: half-approx parity FAILED" >&2
+    exit 1
+fi
+
+echo "== elastic-resume parity (preempt at mesh 8, resume at mesh 2) =="
+# Mesh-portable snapshots: a preempted run resumed on a different mesh size
+# must replay its committed passes and stay bit-identical to a clean run
+# (both shrink and grow directions).  VERIFY_SKIP_ELASTIC=1 opts out.
+if [ "${VERIFY_SKIP_ELASTIC:-0}" = "1" ]; then
+    echo "verify: elastic-resume parity skipped (VERIFY_SKIP_ELASTIC=1)"
+elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/elastic_resume_parity.py; then
+    echo "verify: elastic-resume parity FAILED" >&2
     exit 1
 fi
 

@@ -151,7 +151,10 @@ def test_progress_codec_roundtrip():
         0: ([np.arange(3, dtype=np.int64), np.ones(2, np.int32)], (1, 2, 3)),
         2: ([np.zeros(0, np.int64), np.arange(4, dtype=np.int32)], (4, 5, 6)),
     }
-    out = checkpoint.decode_progress(checkpoint.encode_progress(parts))
+    snap = checkpoint.decode_progress(
+        checkpoint.encode_progress(parts, num_dev=8, n_pass=3))
+    assert snap.num_dev == 8 and snap.n_pass == 3
+    out = snap.parts
     assert sorted(out) == [0, 2]
     for p in parts:
         got_blocks, got_tele = out[p]
@@ -165,15 +168,20 @@ def test_progress_codec_roundtrip():
 def test_progress_store_roundtrip_and_cleanup(tmp_path):
     store = checkpoint.ProgressStore(
         checkpoint.CheckpointStore(str(tmp_path)), "base")
-    stage, fp = store.phase_fp("cind", 0, n_pass=3, num_dev=8)
+    stage, fp = store.phase_fp("cind", 0)
     parts = {0: ([np.arange(5)], (7, 8, 9))}
-    store.submit(stage, fp, parts)
+    store.submit(stage, fp, parts, num_dev=8, n_pass=3)
     store.flush()
-    assert store.load(stage, fp) is not None
-    # A different n_pass fingerprints differently: stale snapshots miss.
-    stage2, fp2 = store.phase_fp("cind", 0, n_pass=6, num_dev=8)
-    assert stage2 == stage and fp2 != fp
-    assert store.load(stage2, fp2) is None
+    snap = store.load(stage, fp)
+    assert snap is not None
+    assert snap.num_dev == 8 and snap.n_pass == 3
+    # The fingerprint is mesh-portable: neither num_dev nor n_pass feeds it
+    # (they ride the snapshot as metadata and are resolved at resume time),
+    # but the phase extras still do.
+    stage2, fp2 = store.phase_fp("cind", 0)
+    assert stage2 == stage and fp2 == fp
+    _, fp3 = store.phase_fp("cind", 0, extra={"what": "other"})
+    assert fp3 != fp
     store.cleanup()
     assert store.load(stage, fp) is None
 

@@ -1,0 +1,494 @@
+"""Elastic resume: mesh-portable per-pass snapshots, the multi-host
+agreement vote, and the in-driver preemption supervisor.
+
+Fast tier: the host-side bucket-routing replica vs the device kernel, the
+vote's decision table against a scripted allgather, one mesh-shrink (8 -> 2)
+resume differential, and the supervisor surviving a 3-preempt storm through
+the driver.  Slow tier: the mesh-grow direction, pass-count adoption, and
+torn/old-format snapshots as clean misses (their decision logic is already
+unit-covered fast).  Chaos tier: kill-at-every-pass across mesh changes and
+the strategy sweep under a mid-run mesh shrink.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rdfind_tpu.models import allatonce, sharded
+from rdfind_tpu.ops import hashing
+from rdfind_tpu.parallel.mesh import make_mesh
+from rdfind_tpu.runtime import checkpoint, driver, faults
+from rdfind_tpu.utils.synth import generate_triples
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 CPU devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("RDFIND_FAULTS", raising=False)
+    monkeypatch.delenv("RDFIND_STRICT", raising=False)
+    monkeypatch.setenv("RDFIND_BACKOFF_BASE_MS", "1")
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv("RDFIND_FAULTS", spec)
+    faults.reset()
+
+
+def _disarm(monkeypatch):
+    monkeypatch.delenv("RDFIND_FAULTS", raising=False)
+    faults.reset()
+
+
+def _workload():
+    # Same shape as test_faults' workload: the jitted pass programs are
+    # shared across the fast tier's process-wide jit cache.
+    return generate_triples(300, seed=21, n_predicates=8, n_entities=32)
+
+
+def _progress(tmp_path, name="p"):
+    return checkpoint.ProgressStore(
+        checkpoint.CheckpointStore(str(tmp_path / name)), "base")
+
+
+# ---------------------------------------------------------------------------
+# The re-shard primitive: host replica == device kernel, bit for bit.
+# ---------------------------------------------------------------------------
+
+
+def test_host_bucket_replica_matches_device_kernel():
+    """_host_bucket_of must reproduce ops.hashing.bucket_of exactly — the
+    re-shard on load routes reloaded rows with the host replica, and one
+    mismatched bucket would silently corrupt a resumed exchange."""
+    rng = np.random.default_rng(0)
+    cols = [rng.integers(0, 2**31 - 1, size=257).astype(np.int64)
+            for _ in range(3)]
+    for n in (1, 2, 3, 4, 8, 12):
+        want = np.asarray(hashing.bucket_of(
+            [jnp.asarray(c.astype(np.int32)) for c in cols], n,
+            seed=sharded._SEED_CAPTURE))
+        got = sharded._host_bucket_of(cols, n, seed=sharded._SEED_CAPTURE)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_reshard_pass_rows_is_permutation_and_deterministic():
+    rng = np.random.default_rng(1)
+    cols = [rng.integers(0, 1000, size=64).astype(np.int64)
+            for _ in range(6)] + [rng.integers(1, 9, size=64)]
+    out4 = sharded._reshard_pass_rows(cols, 4)
+    # Same multiset of rows, every column permuted by the SAME order.
+    rows_in = sorted(zip(*[c.tolist() for c in cols]))
+    rows_out = sorted(zip(*[c.tolist() for c in out4]))
+    assert rows_in == rows_out
+    again = sharded._reshard_pass_rows(cols, 4)
+    for a, b in zip(out4, again):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Mesh-portable resume differentials (fast tier: one shrink, one grow).
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_shrink_resume_bit_identical(mesh8, tmp_path, monkeypatch):
+    """Preempted at mesh 8, resumed at mesh 2: the committed passes re-shard
+    on load and the CIND table is bit-identical to a never-preempted run."""
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    ref = allatonce.discover(triples, 2)
+
+    _arm(monkeypatch, "preempt@discover:pass=1")
+    with pytest.raises(faults.Preempted):
+        sharded.discover_sharded(triples, 2, mesh=mesh8,
+                                 progress=_progress(tmp_path))
+    _disarm(monkeypatch)
+
+    stats: dict = {}
+    table = sharded.discover_sharded(triples, 2, mesh=make_mesh(2),
+                                     stats=stats,
+                                     progress=_progress(tmp_path))
+    assert stats["resumed_passes"] == 2
+    er = stats["elastic_resume"]
+    assert er["from_num_dev"] == 8
+    assert er["to_num_dev"] == 2
+    assert er["resharded_blocks"] >= 2
+    assert er["resharded_bytes"] > 0
+    assert table.to_rows() == ref.to_rows()
+
+
+@pytest.mark.slow
+def test_mesh_grow_resume_bit_identical(tmp_path, monkeypatch):
+    """The upward direction: a single-device run's snapshot resumes on the
+    full 8-device mesh (capacity came BACK after the preemption)."""
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    ref = allatonce.discover(triples, 2)
+
+    _arm(monkeypatch, "preempt@discover:pass=1")
+    with pytest.raises(faults.Preempted):
+        sharded.discover_sharded(triples, 2, mesh=make_mesh(1),
+                                 progress=_progress(tmp_path))
+    _disarm(monkeypatch)
+
+    stats: dict = {}
+    table = sharded.discover_sharded(triples, 2, mesh=make_mesh(8),
+                                     stats=stats,
+                                     progress=_progress(tmp_path))
+    assert stats["resumed_passes"] == 2
+    assert stats["elastic_resume"]["from_num_dev"] == 1
+    assert table.to_rows() == ref.to_rows()
+
+
+@pytest.mark.slow
+def test_n_pass_adoption_from_snapshot(mesh8, tmp_path, monkeypatch):
+    """A resumed run whose OWN plan would pick a different pass count adopts
+    the snapshot's partition (caps re-derived from the stashed plan maxima)
+    instead of discarding the committed work."""
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    ref = allatonce.discover(triples, 2)
+    stats0: dict = {}
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats0)
+    written_n_pass = stats0["n_pair_passes"]
+    assert written_n_pass > 2
+
+    _arm(monkeypatch, "preempt@discover:pass=1")
+    with pytest.raises(faults.Preempted):
+        sharded.discover_sharded(triples, 2, mesh=mesh8,
+                                 progress=_progress(tmp_path))
+    _disarm(monkeypatch)
+
+    # Resume under a HALVED row budget: the fresh plan wants ~2x the passes,
+    # but the snapshot's partition wins (n_splits == 0, adoption allowed).
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 12)
+    stats: dict = {}
+    table = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats,
+                                     progress=_progress(tmp_path))
+    assert stats["resumed_passes"] == 2
+    assert stats["n_pair_passes"] == written_n_pass
+    assert stats["elastic_resume"]["adopted_n_pass"] == written_n_pass
+    assert table.to_rows() == ref.to_rows()
+
+
+# ---------------------------------------------------------------------------
+# Clean-miss guarantees: torn files and old snapshot formats never resume.
+# ---------------------------------------------------------------------------
+
+
+def _kill_then_snapshot_files(mesh, tmp_path, monkeypatch):
+    triples = _workload()
+    _arm(monkeypatch, "preempt@discover:pass=1")
+    with pytest.raises(faults.Preempted):
+        sharded.discover_sharded(triples, 2, mesh=mesh,
+                                 progress=_progress(tmp_path))
+    _disarm(monkeypatch)
+    files = sorted((tmp_path / "p").glob("progress-*.npz"))
+    assert files, "the preempted run must leave per-pass snapshots"
+    return triples, files
+
+
+@pytest.mark.slow
+def test_torn_snapshot_is_clean_miss(mesh8, tmp_path, monkeypatch):
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    triples, files = _kill_then_snapshot_files(mesh8, tmp_path, monkeypatch)
+    for f in files:
+        raw = f.read_bytes()
+        f.write_bytes(raw[: len(raw) // 2])
+    stats: dict = {}
+    table = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats,
+                                     progress=_progress(tmp_path))
+    assert "resumed_passes" not in stats
+    assert table.to_rows() == allatonce.discover(triples, 2).to_rows()
+
+
+@pytest.mark.slow
+def test_old_format_snapshot_is_clean_miss(mesh8, tmp_path, monkeypatch):
+    """A snapshot written under an older CHECKPOINT_FORMAT (e.g. the
+    pre-elastic layout that baked num_dev into the fingerprint) must read
+    as a miss — the fingerprint embeds the format version."""
+    monkeypatch.setattr(checkpoint, "CHECKPOINT_FORMAT",
+                        checkpoint.CHECKPOINT_FORMAT - 1)
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    triples, _ = _kill_then_snapshot_files(mesh8, tmp_path, monkeypatch)
+    monkeypatch.undo()
+    monkeypatch.setenv("RDFIND_BACKOFF_BASE_MS", "1")
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    stats: dict = {}
+    table = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats,
+                                     progress=_progress(tmp_path))
+    assert "resumed_passes" not in stats
+    assert table.to_rows() == allatonce.discover(triples, 2).to_rows()
+
+
+# ---------------------------------------------------------------------------
+# The agreement vote, against a scripted allgather (single process).
+# ---------------------------------------------------------------------------
+
+
+class _VoteHarness:
+    """Minimal _Pipeline stand-in exposing _resolve_resume's dependencies."""
+
+    _resolve_resume = sharded._Pipeline._resolve_resume
+    _note_resume = sharded._Pipeline._note_resume
+
+    def __init__(self, n_pass=4, num_dev=8):
+        self.n_pass = n_pass
+        self.num_dev = num_dev
+        self.stats: dict = {}
+        self.adopted = None
+
+    def _adopt_n_pass(self, n_pass):
+        self.adopted = int(n_pass)
+        self.n_pass = int(n_pass)
+
+
+def _scripted_vote(monkeypatch, responses):
+    """Patch sharded's allgather + process_count; returns the call log."""
+    calls = []
+    resp = [np.asarray(r, np.float64) for r in responses]
+
+    def fake_allgather(values):
+        calls.append(np.asarray(values, np.float64).ravel().tolist())
+        return resp.pop(0)
+
+    monkeypatch.setattr(sharded, "allgather_host_values", fake_allgather)
+    monkeypatch.setattr(sharded, "jax",
+                        types.SimpleNamespace(process_count=lambda: 2))
+    return calls
+
+
+def _snap(parts, num_dev=8, n_pass=4):
+    return checkpoint.ProgressSnapshot(parts=parts, num_dev=num_dev,
+                                       n_pass=n_pass)
+
+
+def test_vote_full_agreement_resumes_intersection(monkeypatch):
+    h = _VoteHarness()
+    calls = _scripted_vote(monkeypatch, [
+        [[1, 4], [1, 4]],          # round 1: both hold n_pass=4 snapshots
+        [[1, 1, 0, 0], [1, 0, 0, 0]],  # round 2: peer only committed pass 0
+    ])
+    out = h._resolve_resume(_snap({0: "a", 1: "b"}), allow_adopt=True)
+    assert sorted(out) == [0]
+    assert len(calls) == 2
+    assert calls[1] == [1.0, 1.0, 0.0, 0.0]  # our bitmap, under cand=4
+    assert h.stats["elastic_resume"]["vote_rounds"] == 2
+    assert h.adopted is None
+
+
+def test_vote_missing_peer_shrinks_to_empty(monkeypatch):
+    h = _VoteHarness()
+    _scripted_vote(monkeypatch, [
+        [[1, 4], [0, 0]],              # peer lost its snapshot entirely
+        [[1, 1, 0, 0], [0, 0, 0, 0]],  # it contributes a zero bitmap
+    ])
+    out = h._resolve_resume(_snap({0: "a", 1: "b"}), allow_adopt=True)
+    assert out == {}
+
+
+def test_vote_partition_disagreement_is_full_rerun(monkeypatch):
+    h = _VoteHarness()
+    calls = _scripted_vote(monkeypatch, [
+        [[1, 4], [1, 6]],  # holders disagree: one file predates a split
+    ])
+    out = h._resolve_resume(_snap({0: "a"}), allow_adopt=True)
+    assert out == {}
+    assert len(calls) == 1  # round 2 skipped deterministically on all hosts
+    assert h.stats["elastic_resume"]["vote_rounds"] == 1
+
+
+def test_vote_unadoptable_partition_skips_round_two(monkeypatch):
+    h = _VoteHarness(n_pass=4)
+    calls = _scripted_vote(monkeypatch, [
+        [[1, 8], [1, 8]],  # stored partition differs from this attempt's
+    ])
+    out = h._resolve_resume(_snap({0: "a"}, n_pass=8), allow_adopt=False)
+    assert out == {}
+    assert len(calls) == 1
+
+
+def test_vote_adopts_common_partition(monkeypatch):
+    h = _VoteHarness(n_pass=4)
+    _scripted_vote(monkeypatch, [
+        [[1, 2], [1, 2]],
+        [[1, 1], [1, 1]],
+    ])
+    out = h._resolve_resume(_snap({0: "a", 1: "b"}, n_pass=2),
+                            allow_adopt=True)
+    assert sorted(out) == [0, 1]
+    assert h.adopted == 2
+    assert h.stats["elastic_resume"]["adopted_n_pass"] == 2
+
+
+def test_vote_no_holders_anywhere(monkeypatch):
+    h = _VoteHarness()
+    calls = _scripted_vote(monkeypatch, [[[0, 0], [0, 0]]])
+    assert h._resolve_resume(None, allow_adopt=True) == {}
+    assert len(calls) == 1  # the vote still ran: no host may skip it
+
+
+# ---------------------------------------------------------------------------
+# The in-driver preemption supervisor.
+# ---------------------------------------------------------------------------
+
+_STORM_NT = "".join(
+    f"<http://x/s{i % 12}> <http://x/p{i % 5}> \"v{i % 7}\" .\n"
+    for i in range(80))
+
+
+def test_supervisor_survives_three_preempt_storm(tmp_path, monkeypatch):
+    """--retry-on-preempt 3 under preemptions at three consecutive passes:
+    the driver retries in-process, resumes each time from the flushed
+    snapshots, and completes with the clean run's table."""
+    f = tmp_path / "storm.nt"
+    f.write_text(_STORM_NT)
+    # ~8 passes for this workload: enough for the 3-pass storm, cheap to run.
+    monkeypatch.setenv("RDFIND_PAIR_ROW_BUDGET", "512")
+
+    def cfg(**kw):
+        return driver.Config(input_paths=[str(f)], min_support=1,
+                             n_devices=8, traversal_strategy=0, **kw)
+
+    clean = driver.run(cfg())
+    assert clean.counters["stat-n_pair_passes"] > 3
+
+    _arm(monkeypatch, "preempt@discover:pass=0;preempt@discover:pass=1;"
+                      "preempt@discover:pass=2")
+    out = driver.run(cfg(checkpoint_dir=str(tmp_path / "ck"),
+                         retry_on_preempt=3))
+    _disarm(monkeypatch)
+    assert out.counters["supervisor-attempts"] == 3
+    assert out.counters["stat-resumed_passes"] >= 3
+    assert out.table.to_rows() == clean.table.to_rows()
+
+
+def test_supervisor_zero_budget_propagates(tmp_path, monkeypatch):
+    """The historical contract: without a retry budget, Preempted escapes
+    run() for the CLI's exit-75 path."""
+    f = tmp_path / "storm.nt"
+    f.write_text(_STORM_NT)
+    monkeypatch.setenv("RDFIND_PAIR_ROW_BUDGET", "512")
+    _arm(monkeypatch, "preempt@discover:pass=0")
+    with pytest.raises(faults.Preempted):
+        driver.run(cfg := driver.Config(
+            input_paths=[str(f)], min_support=1, n_devices=8,
+            traversal_strategy=0, checkpoint_dir=str(tmp_path / "ck")))
+    _disarm(monkeypatch)
+    # And the flushed snapshot still resumes an external restart.
+    out = driver.run(cfg)
+    assert out.counters["stat-resumed_passes"] >= 1
+
+
+def test_retry_budget_env_fallback(monkeypatch):
+    monkeypatch.setenv("RDFIND_RETRY_ON_PREEMPT", "2")
+    assert driver._retry_budget(driver.Config(input_paths=[])) == 2
+    assert driver._retry_budget(
+        driver.Config(input_paths=[], retry_on_preempt=5)) == 5
+    monkeypatch.setenv("RDFIND_RETRY_ON_PREEMPT", "bogus")
+    assert driver._retry_budget(driver.Config(input_paths=[])) == 0
+    monkeypatch.delenv("RDFIND_RETRY_ON_PREEMPT")
+    assert driver._retry_budget(driver.Config(input_paths=[])) == 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos tier: kill at every pass across mesh changes, and the strategy
+# sweep under a mid-run shrink.
+# ---------------------------------------------------------------------------
+
+_SHARDED_STRATEGIES = (
+    ("allatonce", sharded.discover_sharded),
+    ("small_to_large", sharded.discover_sharded_s2l),
+    ("approximate", sharded.discover_sharded_approx),
+    ("late_bb", sharded.discover_sharded_late_bb),
+)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("to_dev", [4, 2, 1])
+def test_kill_at_every_pass_mesh_shrink(mesh8, to_dev, tmp_path,
+                                        monkeypatch):
+    """For every pass k: preempt right after pass k commits at mesh 8, then
+    resume at a smaller mesh — bit-identical, every k, every target size."""
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    mesh_to = make_mesh(to_dev)
+    ref = sharded.discover_sharded(triples, 2, mesh=mesh_to).to_rows()
+    stats: dict = {}
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    n_pass = stats["n_pair_passes"]
+    assert n_pass > 2
+    for k in range(n_pass):
+        prog_dir = tmp_path / f"kill{k}"
+        _arm(monkeypatch, f"preempt@discover:pass={k}")
+        with pytest.raises(faults.Preempted):
+            sharded.discover_sharded(triples, 2, mesh=mesh8,
+                                     progress=_progress(prog_dir))
+        _disarm(monkeypatch)
+        s: dict = {}
+        table = sharded.discover_sharded(triples, 2, mesh=mesh_to, stats=s,
+                                         progress=_progress(prog_dir))
+        assert s["resumed_passes"] == k + 1, (to_dev, k)
+        assert table.to_rows() == ref, (to_dev, k)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_kill_at_every_pass_mesh_grow(mesh8, tmp_path, monkeypatch):
+    """The 1 -> 8 direction of the same differential."""
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    mesh1 = make_mesh(1)
+    ref = sharded.discover_sharded(triples, 2, mesh=mesh8).to_rows()
+    stats: dict = {}
+    sharded.discover_sharded(triples, 2, mesh=mesh1, stats=stats)
+    n_pass = stats["n_pair_passes"]
+    assert n_pass > 2
+    for k in range(n_pass):
+        prog_dir = tmp_path / f"kill{k}"
+        _arm(monkeypatch, f"preempt@discover:pass={k}")
+        with pytest.raises(faults.Preempted):
+            sharded.discover_sharded(triples, 2, mesh=mesh1,
+                                     progress=_progress(prog_dir))
+        _disarm(monkeypatch)
+        s: dict = {}
+        table = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=s,
+                                         progress=_progress(prog_dir))
+        assert s["resumed_passes"] == k + 1, k
+        assert table.to_rows() == ref, k
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_mesh_shrink_all_strategies(mesh8, tmp_path, monkeypatch):
+    """Every sharded strategy survives a preempt-at-mesh-8 / resume-at-mesh-2
+    cycle bit-identically (the S2L and half-approx paths carry cooc and
+    sketch snapshot layouts through the re-shard/fold)."""
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    mesh2 = make_mesh(2)
+    for name, fn in _SHARDED_STRATEGIES:
+        ref = fn(triples, 2, mesh=mesh2).to_rows()
+        prog_dir = tmp_path / name
+        _arm(monkeypatch, "preempt@discover:pass=1")
+        try:
+            table = fn(triples, 2, mesh=mesh8, progress=_progress(prog_dir))
+        except faults.Preempted:
+            _disarm(monkeypatch)
+            s: dict = {}
+            table = fn(triples, 2, mesh=mesh2, stats=s,
+                       progress=_progress(prog_dir))
+            assert s["resumed_passes"] >= 1, name
+        _disarm(monkeypatch)
+        assert table.to_rows() == ref, name

@@ -7,6 +7,7 @@ boundary over the distributed runtime (the DCN path of SURVEY §2h).
 
 import json
 import os
+import shutil
 import socket
 import subprocess
 import sys
@@ -95,26 +96,40 @@ NT_SHARDS = [
 
 def _cpu_env(fake_devices: int | None = None):
     """Worker env: strip the conftest's backend pins; optionally re-pin CPU
-    with a fake-device mesh (the CLI workers read these)."""
+    with a fake-device mesh (the CLI workers read these).  The conftest's
+    probed XLA tuning flags (-O0 test compiles, collective patience) ARE
+    forwarded — each worker cold-compiles every program on the one-core box,
+    and default-opt compiles there both dominate the test's wall clock and
+    widen the rendezvous stagger that wedges gloo."""
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
     if fake_devices is not None:
         env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = (
-            f"--xla_force_host_platform_device_count={fake_devices}")
+        flags.append(f"--xla_force_host_platform_device_count={fake_devices}")
+    if flags:
+        env["XLA_FLAGS"] = " ".join(flags)
     return env
 
 
-def _run_procs(cmds, env, timeout=540, retries=1):
-    """Spawn one process per command, gather (stdout, stderr), assert rc=0.
+def _run_procs(cmds, env, timeout=540, retries=1, on_retry=None, want_rc=0):
+    """Spawn one process per command, gather (stdout, stderr), assert rc.
 
-    On a communicate() timeout every peer is killed before the raise — a hung
+    On a communicate() timeout every peer is killed before the retry — a hung
     coordinated worker must not leak and wedge later tests.  One retry covers
     coordination-service infrastructure flakes (gloo "Connection closed by
-    peer" / heartbeat timeouts when the one-core box starves a worker of CPU
-    mid-rendezvous); a deterministic failure still fails both attempts."""
+    peer" / heartbeat timeouts, and full rendezvous wedges that ride out the
+    per-attempt timeout, when the one-core box starves a worker of CPU
+    mid-rendezvous); a deterministic failure still fails both attempts.
+    `on_retry` runs before each retry attempt so tests with on-disk side
+    effects (checkpoint dirs) can restore their pre-attempt state — a dead
+    attempt's partial checkpoints must not leak into the retry and flip its
+    resume assertions."""
     last_err = None
-    for _ in range(retries + 1):
+    for attempt in range(retries + 1):
+        if attempt and on_retry is not None:
+            on_retry()
         procs = [subprocess.Popen(c, cwd=_REPO, stdout=subprocess.PIPE,
                                   stderr=subprocess.PIPE, text=True, env=env)
                  for c in cmds]
@@ -122,15 +137,43 @@ def _run_procs(cmds, env, timeout=540, retries=1):
             with ThreadPoolExecutor(len(procs)) as ex:
                 outs = list(ex.map(lambda p: p.communicate(timeout=timeout),
                                    procs))
+        except subprocess.TimeoutExpired:
+            # Reap every worker, not just the one that timed out, then treat
+            # the wedge like any other infra flake: one fresh retry.
+            for p in procs:
+                p.kill()
+            for p in procs:
+                p.wait()
+            last_err = f"workers wedged past the {timeout}s timeout"
+            continue
         except Exception:
             for p in procs:
                 p.kill()
             raise
-        if all(p.returncode == 0 for p in procs):
+        if all(p.returncode == want_rc for p in procs):
             return outs
         last_err = next(err for p, (_, err) in zip(procs, outs)
-                        if p.returncode != 0)
+                        if p.returncode != want_rc)
     raise AssertionError(f"worker failed:\n{last_err[-2000:]}")
+
+
+def _dir_restorer(path):
+    """Capture a directory's state NOW; the returned callable restores it.
+
+    Handed to _run_procs as on_retry by the checkpointed tests: an
+    infrastructure-flake retry must see the same on-disk state the failed
+    attempt started from, not whatever partial checkpoints it left behind."""
+    snap = ({p.name: p.read_bytes() for p in path.iterdir() if p.is_file()}
+            if path.exists() else None)
+
+    def restore():
+        if path.exists():
+            shutil.rmtree(path)
+        if snap is not None:
+            path.mkdir()
+            for name, data in snap.items():
+                (path / name).write_bytes(data)
+    return restore
 
 
 def _run_ingest_workers(paths, mode: str, strategy: str = "0"):
@@ -327,7 +370,8 @@ def test_two_process_sharded_ingest_checkpoint_resume(tmp_path):
               "--checkpoint-dir", str(ck), "--output", str(out),
               "--coordinator", f"127.0.0.1:{port}",
               "--num-hosts", "2", "--host-index", str(pid)]
-             for pid in range(2)], _cpu_env(fake_devices=4))
+             for pid in range(2)], _cpu_env(fake_devices=4),
+            on_retry=_dir_restorer(ck))
         return out.read_text(), outs[0][1]
 
     first_out, first_err = run("first")
@@ -347,3 +391,67 @@ def test_two_process_sharded_ingest_checkpoint_resume(tmp_path):
     assert "resumed-discover" not in third_err
     assert "resumed-ingest: 1" in third_err  # ingest caches are per-host
     assert third_out == first_out
+
+
+def test_two_process_preempt_kill_then_vote_resume(tmp_path):
+    """Elastic resume across REAL process boundaries: an injected preemption
+    kills both workers mid-discovery (exit 75) after per-pass progress
+    snapshots were committed; the successor pair agrees on the committed-pass
+    intersection via the allgather vote and resumes, bit-identical to a run
+    that was never preempted."""
+    paths = []
+    for i, content in enumerate(NT_SHARDS[:2]):
+        p = tmp_path / f"shard{i}.nt"
+        p.write_text(content)
+        paths.append(str(p))
+    ck = tmp_path / "ck"
+
+    def run(tag, faults_env, want_rc):
+        out = tmp_path / f"{tag}.tsv"
+        port = _free_port()
+        env = _cpu_env(fake_devices=4)
+        # Small enough for ~3 passes per phase (so the kill at pass 1 leaves
+        # committed work behind AND uncommitted work to redo), large enough
+        # to stay clear of the many-tiny-collectives gloo instability.
+        env["RDFIND_PAIR_ROW_BUDGET"] = "64"
+        env["RDFIND_BACKOFF_BASE_MS"] = "1"
+        if faults_env:
+            env["RDFIND_FAULTS"] = faults_env
+        _run_procs(
+            [[sys.executable, "-m", "rdfind_tpu.programs.rdfind", *paths,
+              "--support", "1", "--sharded-ingest", "--counters", "1",
+              "--checkpoint-dir", str(ck), "--output", str(out),
+              "--coordinator", f"127.0.0.1:{port}",
+              "--num-hosts", "2", "--host-index", str(pid)]
+             for pid in range(2)], env,
+            on_retry=_dir_restorer(ck), want_rc=want_rc)
+        return out
+
+    run("killed", "preempt@discover:pass=1", 75)
+    assert any(p.name.startswith("progress-") for p in ck.iterdir()), \
+        "the preempted attempt must leave per-pass snapshots behind"
+
+    out = tmp_path / "resumed.tsv"
+    port = _free_port()
+    env = _cpu_env(fake_devices=4)
+    env["RDFIND_PAIR_ROW_BUDGET"] = "64"
+    outs = _run_procs(
+        [[sys.executable, "-m", "rdfind_tpu.programs.rdfind", *paths,
+          "--support", "1", "--sharded-ingest", "--counters", "1",
+          "--checkpoint-dir", str(ck), "--output", str(out),
+          "--coordinator", f"127.0.0.1:{port}",
+          "--num-hosts", "2", "--host-index", str(pid)]
+         for pid in range(2)], env, on_retry=_dir_restorer(ck))
+    resumed = dict(l.split(": ", 1) for l in outs[0][1].splitlines()
+                   if l.startswith("stat-resumed_passes"))
+    assert int(resumed.get("stat-resumed_passes", "0")) > 0, outs[0][1][-2000:]
+
+    # Reference: the same workload, never preempted, fresh checkpoint state.
+    r = subprocess.run(
+        [sys.executable, "-m", "rdfind_tpu.programs.rdfind", *paths,
+         "--support", "1", "--output", str(tmp_path / "clean.tsv")],
+        cwd=_REPO, capture_output=True, text=True,
+        env=_cpu_env(fake_devices=4), timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert sorted(out.read_text().splitlines()) == \
+        sorted((tmp_path / "clean.tsv").read_text().splitlines())
