@@ -27,6 +27,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from rdfind_tpu import obs  # noqa: E402
+from rdfind_tpu.obs import integrity as obs_integrity  # noqa: E402
 from rdfind_tpu.obs import report as obs_report  # noqa: E402
 from rdfind_tpu.obs import sentinel as obs_sentinel  # noqa: E402
 
@@ -826,6 +827,14 @@ def _run(n: int, min_support: int) -> dict:
         "degradations": stats.get("degradations"),
         "oracle_wall_s": round(oracle_elapsed, 3),
         "oracle_pairs_per_sec": round(oracle_pairs_per_sec, 1),
+        # Integrity plane: the headline run's output digest plus the
+        # workload it was computed over.  The sentinel compares digests only
+        # between rows with the same workload (and provenance key), so a
+        # digest change there is a correctness — not perf — regression.
+        "output_digest": obs_integrity.digest_hex(
+            *obs_integrity.digest_table(table)),
+        "workload": {"n_triples": n, "min_support": min_support,
+                     "seed": 42},
     }
 
     # The DEFAULT strategy (SmallToLarge, id 1) on the same workload, so the

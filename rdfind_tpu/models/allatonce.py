@@ -37,7 +37,7 @@ import numpy as np
 
 from .. import conditions as cc
 from ..data import CindTable
-from ..obs import datastats, metrics
+from ..obs import datastats, integrity, metrics
 from ..ops import cooc, frequency, minimality, pairs, segments
 from ..ops.emission import emit_join_candidates
 
@@ -479,6 +479,7 @@ def _postprocess(table, triples, min_support, use_ars, clean_implied, stats):
         table = filter_ar_implied_cinds(table, rules)
     if clean_implied:
         table = minimality.minimize_table(table)
+    integrity.publish_output(stats, table)
     return table
 
 

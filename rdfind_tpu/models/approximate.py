@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from ..data import CindTable
-from ..obs import metrics
+from ..obs import integrity, metrics
 from ..ops import cooc as cooc_ops
 from ..ops import frequency, minimality, segments, sketch
 from ..runtime import dispatch
@@ -386,4 +386,5 @@ def discover(triples, min_support: int, projections: str = "spo",
         table = allatonce.filter_ar_implied_cinds(table, rules)
     if clean_implied:
         table = minimality.minimize_table(table)
+    integrity.publish_output(stats, table)
     return table

@@ -27,7 +27,7 @@ import numpy as np
 
 from .. import conditions as cc
 from ..data import CindTable
-from ..obs import metrics
+from ..obs import integrity, metrics
 from ..ops import frequency, minimality, sketch
 from . import allatonce, approximate, small_to_large
 
@@ -113,4 +113,5 @@ def discover(triples, min_support: int, projections: str = "spo",
         table = allatonce.filter_ar_implied_cinds(table, rules)
     if clean_implied:
         table = minimality.minimize_table(table)
+    integrity.publish_output(stats, table)
     return table

@@ -83,7 +83,7 @@ from .. import conditions as cc
 from ..data import CindTable
 from ..ops import frequency, hashing, minimality, pairs, segments
 from ..ops.emission import emit_join_candidates
-from ..obs import datastats, forecast
+from ..obs import datastats, forecast, integrity
 from ..obs import memory as obs_memory
 from ..obs import metrics, tracer
 from ..parallel import exchange
@@ -165,6 +165,8 @@ _SEED_PASS = 7      # dep-slice selection for bounded-memory pair passes
 _SEED_UNARY = 11    # +f, f in 0..2: frequency count exchanges
 _SEED_BINARY = 17   # +k, k in 0..2
 _SEED_HA = 23       # count-min pair keys for the sharded half-approx rounds
+# (The integrity-plane digest lanes use obs/integrity.SEED_A/SEED_B — same
+# mixer, so they must stay clear of every routing seed above.)
 
 
 def _freq_key_sets(triples):
@@ -749,9 +751,26 @@ def _pair_phase(jv, code, v1, v2, n_rows, dep_f, ref_f, *, cap_pairs,
 # followed by the tail counters.  ONE lane array per pass is the whole
 # device->host control surface of the pipelined executor — the host reads it
 # in a single async-staged pull instead of 3+ blocking host_gathers.
-_TELE_LANES = 9  # [ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd, n_giant_lines,
-#                  n_giant_pairs, n_pairs_total, n_ha_cut]
+_TELE_LANES = 11  # [ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd, n_giant_lines,
+#                   n_giant_pairs, n_pairs_total, n_ha_cut, dig_a, dig_b]
 _N_OVF = 5
+# The integrity digest lanes ride at the END of the tail so every existing
+# tail index (datastats' [:3], run_cooc's n_ha_cut at [3]) stays valid; the
+# tail tuple persisted per pass in progress snapshots therefore carries the
+# digests for free, and snapshots are re-verified on load against them.
+_N_TAIL = _TELE_LANES - _N_OVF
+
+
+def _digest_lanes(cols, valid):
+    """The two psum'd integrity-digest lanes over a masked device row set
+    (obs/integrity.py): global uint32 wraparound sums, identical on every
+    device.  Computed unconditionally — the same compiled program runs with
+    the integrity knob on or off (bit-identity; only host-side verification
+    is gated)."""
+    return (jax.lax.psum(hashing.digest_fold(cols, valid,
+                                             seed=integrity.SEED_A), AXIS),
+            jax.lax.psum(hashing.digest_fold(cols, valid,
+                                             seed=integrity.SEED_B), AXIS))
 
 
 def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
@@ -782,9 +801,11 @@ def _cind_device(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps,
     keep = is_cind & ~implied
 
     out_cols, n_out = segments.compact(list(ucols) + [dep_count], keep)
+    dig_a, dig_b = _digest_lanes(
+        out_cols, jnp.arange(out_cols[0].shape[0], dtype=jnp.int32) < n_out)
     tele = exchange.pack_counters([ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd,
                                    n_giant_lines, n_giant_pairs,
-                                   n_pairs_total, n_ha_cut])
+                                   n_pairs_total, n_ha_cut, dig_a, dig_b])
     return (*out_cols, jnp.full(1, n_out, jnp.int32), tele)
 
 
@@ -1091,6 +1112,13 @@ class _Pipeline:
         # pipeline — the per-pass path pays attribute checks only.  The env
         # knob must agree across hosts (same contract as RDFIND_TRACE).
         self._datastats_on = datastats.enabled()
+        # Integrity-plane gate (obs/integrity.py): resolved once per
+        # pipeline.  The device digest lanes are computed unconditionally —
+        # the same compiled program runs with the knob on or off (bit
+        # identity); this flag gates only the host-side recompute, verify
+        # and publish.  The env knob must agree across hosts (same contract
+        # as RDFIND_TRACE).
+        self._integrity_on = integrity.enabled()
 
         # Sharded half-approximate 1/1 (RDFIND_SHARDED_HALF_APPROX): resolved
         # once so every run_cooc level sees one consistent configuration.
@@ -1305,6 +1333,13 @@ class _Pipeline:
                                               used)
             self._collect_datastats()
 
+        # Integrity plane (obs/integrity.py): digest the resident stage
+        # state — the join lines after exchanges A/B + rebalance, and the
+        # capture table after exchange C — as four psum'd lanes in one
+        # device dispatch, O(4) ints pulled however large the state is.
+        if self._integrity_on and stats is not None:
+            self._collect_stage_digests()
+
     def _collect_datastats(self):
         """One device dispatch for the data plane's distribution snapshot:
         the join-line size histogram and giant-line share over the resident
@@ -1330,6 +1365,99 @@ class _Pipeline:
         datastats.publish_capture_spectrum(
             self.stats, hist=datastats.hist_from_bins(chist),
             n_captures=n_capt, max_support=max_sup, source="sharded")
+
+    def _collect_stage_digests(self):
+        """One device dispatch for the integrity plane's resident-state
+        digests: two order/mesh-invariant lanes each for the join-line rows
+        (the exchange A/B commit point) and the capture table (exchange C)."""
+        lanes = _stage_digest(self.mesh)(*self.lines, self.n_rows,
+                                         *self.tbl, self.n_caps)
+        lanes = np.asarray(host_gather(lanes)).reshape(-1, 4)[0]
+        la, lb, ca, cb = (int(x) & integrity.MASK32 for x in lanes)
+        integrity.publish_stage(self.stats, "lines", la, lb)
+        integrity.publish_stage(self.stats, "captures", ca, cb)
+
+    def _host_digest(self, blocks, block_layout):
+        """Host replica of one pass's digest lanes over its pulled or
+        snapshot-loaded blocks (obs/integrity.py)."""
+        if block_layout == "sketch":
+            return integrity.digest_sketch_rows(blocks[0], self.ha_bits)
+        return integrity.digest_rows(blocks)
+
+    def _verify_snapshot(self, resumed, what, block_layout):
+        """Digest-attested resume: recompute each loaded pass's content
+        digest (AFTER any re-shard — the digest is order-invariant, so the
+        _reshard_pass_rows permutation washes out) against the digest lanes
+        persisted in its tail-counter tuple.  A mismatch is a clean miss for
+        that pass plus a named `integrity` degradation — never a corrupted
+        resume; RDFIND_INTEGRITY_STRICT=1 fails the run instead."""
+        out = {}
+        for p, (blocks_p, tele_p) in sorted(resumed.items()):
+            blocks_p = faults.maybe_flip("flip@snapshot", blocks_p,
+                                         pass_idx=p)
+            ok = len(tele_p) >= _N_TAIL
+            if ok:
+                want = integrity.lanes_to_digest(tele_p[-2], tele_p[-1])
+                ok = self._host_digest(blocks_p, block_layout) == want
+            if ok:
+                out[p] = (blocks_p, tele_p)
+                continue
+            if integrity.strict():
+                raise integrity.IntegrityError(
+                    f"{what}: snapshot digest mismatch at pass {p} "
+                    f"(RDFIND_INTEGRITY_STRICT=1)")
+            faults.record_degradation(self.stats, what, "integrity_miss",
+                                      site="snapshot", **{"pass": p})
+            integrity.note_mismatch(self.stats, site="snapshot", stage=what,
+                                    pass_idx=p)
+        return out
+
+    def _verify_pull(self, blocks, tele, p, what, block_layout, cols, n_out):
+        """Verify one freshly pulled pass against its device digest lanes.
+
+        Host pulls are pure reads of committed device state, so in default
+        mode a mismatch re-pulls (bounded by RDFIND_PULL_RETRIES) before it
+        is accepted as real: a transient flip on the host path is REPAIRED
+        and the output stays bit-identical.  Strict mode fails fast on the
+        first mismatch (consistent with RDFIND_STRICT disabling pull
+        retries); a persistent mismatch in default mode degrades flagged —
+        the corrupt pass is named, never silently committed."""
+        want = integrity.lanes_to_digest(tele[-2], tele[-1])
+        blocks = faults.maybe_flip("flip@host_pull", blocks, pass_idx=p)
+        if self._host_digest(blocks, block_layout) == want:
+            return blocks
+        if integrity.strict():
+            raise integrity.IntegrityError(
+                f"{what}: host-pull digest mismatch at pass {p} "
+                f"(RDFIND_INTEGRITY_STRICT=1)")
+        tries = max(1, int(os.environ.get("RDFIND_PULL_RETRIES", "3")))
+        for _ in range(tries):
+            blocks = self.collect_blocks(cols, n_out)
+            if self._host_digest(blocks, block_layout) == want:
+                integrity.note_mismatch(self.stats, site="host_pull",
+                                        stage=what, pass_idx=p,
+                                        repaired=True)
+                return blocks
+        faults.record_degradation(self.stats, what, "integrity_miss",
+                                  site="host_pull", **{"pass": p})
+        integrity.note_mismatch(self.stats, site="host_pull", stage=what,
+                                pass_idx=p)
+        return blocks
+
+    def _check_replica_agreement(self, blocks, tele, p, what, block_layout):
+        """Multi-host digest agreement at the pass boundary: allgather this
+        host's RECOMPUTED block digest and compare rows.  A divergent
+        replica surfaces as a named IntegrityError on EVERY host — each
+        decides from identical allgathered state, so no host wedges a later
+        collective against inconsistent peers.  Runs only when the
+        integrity knob is on (the env must agree across hosts, same
+        contract as RDFIND_TRACE)."""
+        a, b = self._host_digest(blocks, block_layout)
+        rows = allgather_host_values([float(p), float(a), float(b)])
+        if bool((rows.max(axis=0) != rows.min(axis=0)).any()):
+            raise integrity.IntegrityError(
+                f"{what}: replica digest divergence at pass {p}: "
+                f"{rows.tolist()}")
 
     def _maybe_rebalance(self):
         """Greedy least-loaded reassignment of hot lines (the reference's
@@ -1533,14 +1661,18 @@ class _Pipeline:
         under which pass count (possibly adopted from the snapshot).
 
         Single-process this is a local decision.  Multi-process it is the
-        all-hosts-agree vote: round 1 allgathers (has-snapshot, stored
-        n_pass) and picks a candidate partition only if every snapshot
-        holder stored the same one; round 2 allgathers per-pass committed
-        bitmaps under that partition and intersects them.  Every host
-        derives the identical resume set from identical allgathered state,
-        so no host can skip its half of a collective and deadlock the mesh;
-        a host with a torn/missing/stale snapshot just contributes zeros and
-        shrinks the intersection (coarser resume, same results).
+        all-hosts-agree vote, batched into ONE allgather: each host
+        contributes [has, stored n_pass, committed-pass bitmap as eight
+        32-bit words] and every host derives the identical resume set from
+        the identical allgathered rows — candidate partition only if every
+        snapshot holder stored the same one, then the bitwise AND of the
+        bitmap words across ALL hosts (a torn/missing/stale snapshot
+        contributes zero words and shrinks the intersection — coarser
+        resume, same results).  No host can skip its half of a collective
+        and deadlock the mesh.  32-bit words are exact in the float64
+        payload; eight of them cap the vote at 256 passes, so a host whose
+        snapshot stores more votes has=0 (full re-run — a partition that
+        size is outside every planner rung).
 
         `allow_adopt` is False after a split rung re-partitioned the phase
         mid-run: the snapshot's n_pass then no longer matches what THIS
@@ -1558,33 +1690,39 @@ class _Pipeline:
                 self._adopt_n_pass(snap.n_pass)
                 self._note_resume(adopted_n_pass=self.n_pass)
             return dict(snap.parts)
-        # Round 1: (has, stored n_pass).  Hosts must agree on the partition
-        # BEFORE exchanging bitmaps, or the bitmap lengths would diverge.
-        votes = allgather_host_values(
-            [1.0 if has else 0.0, float(snap.n_pass if has else 0)])
+        n_words = 8
+        if has and snap.n_pass > 32 * n_words:
+            has = False
+        vote = np.zeros(2 + n_words, np.float64)
+        if has:
+            vote[0] = 1.0
+            vote[1] = float(snap.n_pass)
+            for p in snap.parts:
+                if 0 <= p < snap.n_pass:
+                    w, bit = divmod(int(p), 32)
+                    vote[2 + w] = float(int(vote[2 + w]) | (1 << bit))
+        votes = allgather_host_values(vote)
+        self._note_resume(vote_rounds=1)
         holders = votes[votes[:, 0] > 0]
         if holders.shape[0] == 0:
-            self._note_resume(vote_rounds=1)
             return {}
         stored = {int(v) for v in holders[:, 1]}
         if len(stored) != 1:
             # Snapshot holders disagree on the partition (one host's file
             # predates a split rung): no pass can be common to all of them.
-            self._note_resume(vote_rounds=1)
             return {}
         cand = stored.pop()
         if cand != self.n_pass and not allow_adopt:
-            self._note_resume(vote_rounds=1)
             return {}
-        # Round 2: committed-pass bitmaps under the agreed partition.
-        bitmap = np.zeros(cand, np.float64)
-        if has and snap.n_pass == cand:
-            for p in snap.parts:
-                if 0 <= p < cand:
-                    bitmap[p] = 1.0
-        common = allgather_host_values(bitmap).min(axis=0)
-        self._note_resume(vote_rounds=2)
-        passes = [p for p in range(cand) if common[p] > 0]
+        # Intersect the committed bitmaps across ALL rows: non-holders
+        # contributed zero words, so any missing/disagreeing host empties
+        # the intersection (the missing-peer semantics of the old round 2).
+        words = [-1] * n_words
+        for row in votes:
+            for w in range(n_words):
+                words[w] &= int(row[2 + w])
+        passes = [p for p in range(cand)
+                  if words[p // 32] & (1 << (p % 32))]
         if not passes:
             return {}
         # A non-empty intersection proves every host holds these passes, so
@@ -1771,6 +1909,8 @@ class _Pipeline:
                     # mesh-agnostic fold in _ha_build_table absorbs any
                     # device count.
                     self._note_resume(from_num_dev=int(snap.num_dev))
+            if resumed and self._integrity_on:
+                resumed = self._verify_snapshot(resumed, what, block_layout)
         # Cap-exhaustion forecaster (obs/forecast.py): fed each committed
         # pass's utilization fractions, it names the cap and predicted pass
         # BEFORE the grow/split rungs fire.  Resolved once per attempt,
@@ -1884,6 +2024,12 @@ class _Pipeline:
                     lambda: self.collect_blocks(cols, n_out),
                     overlapped=bool(inflight), what="pull-blocks")
                 teles[p] = tuple(int(x) for x in tele_h[_N_OVF:])
+                if self._integrity_on:
+                    parts[p] = self._verify_pull(parts[p], teles[p], p, what,
+                                                 block_layout, cols, n_out)
+                    if jax.process_count() > 1:
+                        self._check_replica_agreement(parts[p], teles[p], p,
+                                                      what, block_layout)
                 if self._datastats_on or fc is not None:
                     # Per-pass cap-utilization trajectory from the tail
                     # telemetry lanes (already pulled — zero extra host
@@ -1940,6 +2086,14 @@ class _Pipeline:
                 d.overlap_report((time.perf_counter() - t_attempt) * 1e3,
                                  n_passes=self.n_pass))
         meter.publish()
+        if self._integrity_on and self.stats is not None:
+            # Phase digest: the passes partition this phase's output rows,
+            # so the wraparound sum of the per-pass lanes IS the phase's
+            # digest — invariant to n_pass, row order, and mesh size.
+            da = sum(int(t[-2]) for t in teles) & integrity.MASK32
+            db = sum(int(t[-1]) for t in teles) & integrity.MASK32
+            integrity.publish_stage(self.stats, phase_key, da, db,
+                                    what=what, n_pass=self.n_pass)
         return blocks, tuple(zip(*teles))
 
     def run_cinds(self):
@@ -1951,7 +2105,7 @@ class _Pipeline:
             *cols, n_out, tele = out
             return cols, n_out, tele
 
-        blocks, (ngl, ngp, npt, _) = self._run_passes(step, "pair-phase",
+        blocks, (ngl, ngp, npt, *_) = self._run_passes(step, "pair-phase",
                                                       site="cind",
                                                       phase_key="cind")
         if self.stats is not None:
@@ -1982,7 +2136,7 @@ class _Pipeline:
                 ha_hashes=self.ha_hashes)
             return [table], n_out, tele
 
-        blocks, (ngl, ngp, npt, _) = self._run_passes(
+        blocks, (ngl, ngp, npt, *_) = self._run_passes(
             step, "HA sketch build", site="cooc", phase_key=f"{stat_key}:ha1",
             fp_extra={"flags": digest,
                       "ha": [self.ha_bits, self.ha_hashes, self.ha_thresh]},
@@ -2072,7 +2226,7 @@ class _Pipeline:
             # not satisfy (or be satisfied by) knob-off runs.  Knob-off
             # fingerprints are byte-identical to the historical ones.
             fp_extra["ha"] = [self.ha_bits, self.ha_hashes, self.ha_thresh]
-        blocks, (ngl, ngp, npt, nha) = self._run_passes(
+        blocks, (ngl, ngp, npt, nha, *_) = self._run_passes(
             step, "sharded S2L cooc", site="cooc", phase_key=stat_key,
             fp_extra=fp_extra)
         if self.stats is not None:
@@ -2185,6 +2339,7 @@ def discover_sharded(triples, min_support: int, mesh=None, projections: str = "s
         table = allatonce.filter_ar_implied_cinds(table, rules)
     if clean_implied:
         table = minimality.minimize_table_sharded(table, mesh)
+    _publish_output_digest(stats, table)
     return table
 
 
@@ -2234,9 +2389,11 @@ def _s2l_cooc_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag, n_flags,
         cap_exchange_c_dcn=cap_exchange_c_dcn, hier=hier,
         dcn_chunks=dcn_chunks, ha_cut=ha_cut)
     out_cols, n_out = segments.compact(list(ucols) + [cooc], uvalid)
+    dig_a, dig_b = _digest_lanes(
+        out_cols, jnp.arange(out_cols[0].shape[0], dtype=jnp.int32) < n_out)
     tele = exchange.pack_counters([ovf_p, ovf_c, ovf_g, ovf_gp, ovf_cd,
                                    n_giant_lines, n_giant_pairs,
-                                   n_pairs_total, n_ha_cut])
+                                   n_pairs_total, n_ha_cut, dig_a, dig_b])
     return (*out_cols, jnp.full(1, n_out, jnp.int32), tele)
 
 
@@ -2316,8 +2473,15 @@ def _s2l_sketch_build_device(jv, code, v1, v2, n_rows, fcode, fv1, fv2, fflag,
     table = sketch.count_min_partial(_ha_pair_keys(pcols), pcnt, pvalid2,
                                      bits=ha_bits, num_hashes=ha_hashes)
     z = jnp.int32(0)
+    # Sketch digest: (local position, value) pairs — the psum over devices
+    # matches obs/integrity.digest_sketch_rows over the stacked partials at
+    # any mesh size with the same ha_bits.
+    dig_a, dig_b = _digest_lanes(
+        [jnp.arange(ha_bits, dtype=jnp.int32), table],
+        jnp.ones((ha_bits,), dtype=bool))
     tele = exchange.pack_counters([ovf_p, z, ovf_g, ovf_gp, z, n_giant_lines,
-                                   n_giant_pairs, n_pairs_total, z])
+                                   n_giant_pairs, n_pairs_total, z,
+                                   dig_a, dig_b])
     return table, jnp.full(1, ha_bits, jnp.int32), tele
 
 
@@ -2562,6 +2726,16 @@ def _sharded_prep_approx(triples, min_support, mesh, projections, use_fis,
     return cap_table, cand_dep, cand_ref, backend
 
 
+def _publish_output_digest(stats, table):
+    """Stamp the run's output digest — order-invariant over the final CIND
+    set, so identical across strategies, mesh sizes, and knob settings
+    whenever the logical result is — into the integrity stages."""
+    if stats is not None and integrity.enabled():
+        integrity.publish_stage(
+            stats, "output", *integrity.digest_table(table),
+            rows=int(np.asarray(table.support).shape[0]))
+
+
 def _finish_table(cap_table, d, r, sup, triples, min_support, use_ars,
                   clean_implied, stats, mesh=None, preshard=None):
     from . import allatonce
@@ -2579,6 +2753,7 @@ def _finish_table(cap_table, d, r, sup, triples, min_support, use_ars,
     if clean_implied:
         table = (minimality.minimize_table_sharded(table, mesh)
                  if mesh is not None else minimality.minimize_table(table))
+    _publish_output_digest(stats, table)
     return table
 
 
@@ -2741,9 +2916,11 @@ def discover_sharded_s2l(triples, min_support: int, mesh=None,
         if use_ars and stats is not None:
             metrics.struct_set(stats, "association_rules", rules)
 
-        return small_to_large._run_lattice(
+        table = small_to_large._run_lattice(
             backend.cooc, cap_code, cap_v1, cap_v2, dep_count, num_caps,
             min_support, use_ars, rules, clean_implied, stats, mesh=pipe.mesh)
+        _publish_output_digest(stats, table)
+        return table
     except faults.FallbackRequired as e:
         return _single_device_fallback(
             "small_to_large", e, triples, preshard, min_support, projections,
@@ -2824,6 +3001,24 @@ def _stage_datastats(mesh, giant_load: int):
     return jax.jit(shard_map(
         f, mesh=mesh, in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
         out_specs=(P(), P(), P())))
+
+
+@functools.lru_cache(maxsize=None)
+def _stage_digest(mesh):
+    """Compiled shard_map program: the integrity plane's stage digests over
+    the pipeline's resident state — two order/mesh-invariant content-digest
+    lanes each for the join-line rows and the capture table
+    (obs/integrity.py), packed into one 4-lane array so the host pull is
+    O(4) ints however large the state is."""
+    def f(jv, code, v1, v2, n_rows, tc, tv1, tv2, tcnt, n_caps):
+        lvalid = jnp.arange(jv.shape[0], dtype=jnp.int32) < n_rows[0]
+        la, lb = _digest_lanes([jv, code, v1, v2], lvalid)
+        cvalid = jnp.arange(tc.shape[0], dtype=jnp.int32) < n_caps[0]
+        ca, cb = _digest_lanes([tc, tv1, tv2, tcnt], cvalid)
+        return exchange.pack_counters([la, lb, ca, cb])
+
+    return jax.jit(shard_map(
+        f, mesh=mesh, in_specs=(P(AXIS),) * 10, out_specs=P()))
 
 
 def _stage_join_histogram(mesh, capacity: int, projections: str):

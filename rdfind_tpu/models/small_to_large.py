@@ -46,7 +46,7 @@ import numpy as np
 
 from .. import conditions as cc
 from ..data import NO_VALUE, CindTable
-from ..obs import datastats, metrics
+from ..obs import datastats, integrity, metrics
 from ..ops import cooc as cooc_ops
 from ..ops import frequency, minimality, pairs, segments, sketch
 from ..runtime import dispatch, faults
@@ -977,9 +977,11 @@ def discover(triples, min_support: int, projections: str = "spo",
                  if use_ars else None)
         if use_ars and stats is not None:
             metrics.struct_set(stats, "association_rules", rules)
-        return _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count,
-                                  num_caps, min_support, use_ars, rules,
-                                  clean_implied, stats)
+        table = _run_lattice_dense(dc, cap_code, cap_v1, cap_v2, dep_count,
+                                   num_caps, min_support, use_ars, rules,
+                                   clean_implied, stats)
+        integrity.publish_output(stats, table)
+        return table
     # --- Chunked backend: shared phase A (join lines + capture table + filter).
     st = allatonce.prepare_join_lines(triples, min_support, projections,
                                       use_frequent_condition_filter,
@@ -1015,9 +1017,11 @@ def discover(triples, min_support: int, projections: str = "spo",
         # driver --ar-output reuses these
         metrics.struct_set(stats, "association_rules", rules)
 
-    return _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count, num_caps,
-                        min_support, use_ars, rules, clean_implied, stats,
-                        cooc_fn_11=cooc_fn_11)
+    table = _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count,
+                         num_caps, min_support, use_ars, rules, clean_implied,
+                         stats, cooc_fn_11=cooc_fn_11)
+    integrity.publish_output(stats, table)
+    return table
 
 
 def _run_lattice(cooc_fn, cap_code, cap_v1, cap_v2, dep_count, num_caps,

@@ -16,6 +16,8 @@ is alive, one server per host (``RDFIND_CONSOLE_PORT`` or
               primary host's /progress IS the aggregated multi-host view.
   /datastats  the data plane: join-line histograms, capture spectra,
               block-skip effectiveness (obs/datastats.py's structs)
+  /integrity  the integrity plane: per-stage content digests, verification
+              counters, mismatch events (obs/integrity.py's structs)
   /flightrec  the crash-surviving ring (obs/flightrec.py), newest last
 
 Everything is read-only and served from in-process state (the registry,
@@ -134,6 +136,14 @@ def datastats_payload() -> dict:
             if k.startswith("datastats_")}
 
 
+def integrity_payload() -> dict:
+    """The integrity plane's live view: stage digests, verification
+    counters, and any mismatch events (obs/integrity.py's structs)."""
+    snap = metrics.registry().snapshot(jsonable=True)
+    return {k: v for k, v in sorted(snap.items())
+            if k.startswith("integrity")}
+
+
 def status_payload() -> dict:
     out = {"serving": True, "pid": os.getpid(), "obs_dir": _OBS_DIR}
     if _OBS_DIR:
@@ -173,13 +183,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(progress_payload())
             elif path == "/datastats":
                 self._send_json(datastats_payload())
+            elif path == "/integrity":
+                self._send_json(integrity_payload())
             elif path == "/flightrec":
                 self._send_json({"enabled": flightrec.enabled(),
                                  "events": flightrec.snapshot()})
             elif path == "/":
                 self._send_json({"endpoints": ["/metrics", "/status",
                                                "/progress", "/datastats",
-                                               "/flightrec"]})
+                                               "/integrity", "/flightrec"]})
             else:
                 self._send_json({"error": f"unknown path {path}"}, code=404)
         except Exception as e:  # a bad scrape must never kill the thread

@@ -186,6 +186,14 @@ def build_row(result: dict, backend: str | None = None) -> dict:
         backend = _dig(result, ("detail", "backend"))
     row = {"ts": round(time.time(), 3), **provenance(backend=backend),
            "metrics": extract_metrics(result)}
+    # Integrity plane (obs/integrity.py): the run's output digest plus the
+    # workload it was computed over.  Digests only ever compare between rows
+    # with the same provenance key AND the same workload — the tiny
+    # verify.sh bench and a full bench must never cross-compare.
+    dig = _dig(result, ("detail", "output_digest"))
+    if dig:
+        row["output_digest"] = str(dig)
+        row["workload"] = _dig(result, ("detail", "workload"))
     return row
 
 
@@ -286,6 +294,23 @@ def check_verdict(path: str | None = None, threshold: float | None = None,
             "regressed": regressed}
         if regressed:
             verdict["regressions"].append(name)
+    # Correctness gate (the integrity plane): an output-digest change at an
+    # unchanged provenance key + workload is never noise — the run computed
+    # a DIFFERENT result set on the same inputs.  No threshold, no spread:
+    # any change regresses.
+    new_dig = newest.get("output_digest")
+    if new_dig:
+        wl = json.dumps(newest.get("workload"), sort_keys=True, default=str)
+        prior = sorted({r["output_digest"] for r in baseline
+                        if r.get("output_digest")
+                        and json.dumps(r.get("workload"), sort_keys=True,
+                                       default=str) == wl})
+        changed = bool(prior) and new_dig not in prior
+        verdict["correctness"] = {"output_digest": new_dig,
+                                  "baseline_digests": prior,
+                                  "regressed": changed}
+        if changed:
+            verdict["regressions"].append("output_digest")
     verdict["ok"] = not verdict["regressions"]
     verdict["status"] = "ok" if verdict["ok"] else "regression"
     return verdict
@@ -311,6 +336,11 @@ def check(path: str | None = None, threshold: float | None = None,
         lines.append(f"  {name}: {m['value']} vs median {m['median']} "
                      f"(worse-ratio {m['worse_ratio']:.3f}, "
                      f"gate {m['gate']:.3f}) {verdict}")
+    corr = v.get("correctness")
+    if corr:
+        verdict = ("CORRECTNESS REGRESSION" if corr["regressed"] else "ok")
+        lines.append(f"  output_digest: {corr['output_digest']} vs baseline "
+                     f"{corr['baseline_digests'] or ['(none)']} {verdict}")
     if v["regressions"]:
         lines.append(f"sentinel: REGRESSION in {', '.join(v['regressions'])}")
         return False, lines

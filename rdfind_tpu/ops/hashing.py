@@ -9,6 +9,7 @@ ideal for TPU vector units.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -31,3 +32,18 @@ def hash_cols(cols, seed: int = 0):
 def bucket_of(cols, num_buckets, seed: int = 0):
     """Deterministic bucket id in [0, num_buckets) from int32 key columns."""
     return (hash_cols(cols, seed) % jnp.uint32(num_buckets)).astype(jnp.int32)
+
+
+def digest_fold(cols, valid, seed: int = 0):
+    """One order-invariant content-digest lane over a masked row set: the
+    per-row hash_cols mixes, invalid rows zeroed, summed mod 2^32.
+
+    Returned as an int32 scalar (bitcast, not value-convert) so a psum over
+    per-device partials — int32 two's-complement wraparound — equals the
+    uint32 wraparound sum over ALL rows bit for bit.  The commutative sum
+    makes the lane invariant to row order and device partitioning; the host
+    replica is obs/integrity._fold.
+    """
+    h = jnp.where(valid, hash_cols(cols, seed), jnp.uint32(0))
+    return jax.lax.bitcast_convert_type(jnp.sum(h, dtype=jnp.uint32),
+                                        jnp.int32)

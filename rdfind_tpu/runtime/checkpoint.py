@@ -40,7 +40,10 @@ from . import faults
 # 3: elastic resume — progress snapshots carry (num_dev, n_pass) meta and are
 #    mesh-portable (re-sharded on load), so the mesh size left the progress
 #    fingerprints; old num_dev-keyed snapshots must be a clean miss.
-CHECKPOINT_FORMAT = 3
+# 4: integrity plane — the per-pass tail-counter tuple grew two content-digest
+#    lanes (re-verified on load against the blocks); snapshots without them
+#    cannot be digest-attested and must be a clean miss.
+CHECKPOINT_FORMAT = 4
 
 
 def fingerprint(payload: dict) -> str:

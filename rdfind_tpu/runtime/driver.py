@@ -24,7 +24,7 @@ from ..dictionary import Dictionary, intern_triples
 from ..io import native, ntriples, prefixes, reader
 from ..models import allatonce, approximate, late_bb, sharded, small_to_large
 from ..obs import memory as obs_memory
-from ..obs import console, flightrec, metrics, report, tracer
+from ..obs import console, flightrec, integrity, metrics, report, tracer
 from ..parallel.mesh import make_mesh
 from . import checkpoint
 
@@ -1130,6 +1130,29 @@ def _emit_sinks(cfg: Config, phases: _Phases, counters: dict, table,
     if (cfg.collect_result or cfg.debug_level >= 3) and _is_primary():
         for c in table.decoded(dictionary):
             print(c.pretty())
+
+    if integrity.enabled() and _is_primary():
+        # Integrity plane: fold the counters into stats["integrity"] and
+        # emit the run certificate — input signature -> per-stage digests ->
+        # output digest, provenance-keyed like BENCH_HISTORY rows — when a
+        # destination (RDFIND_CERT or a live trace dir) is configured.
+        summary = integrity.summarize(stats)
+        stages = dict(stats.get("integrity_stages") or {})
+        stages.setdefault("output", integrity.digest_hex(
+            *integrity.digest_table(table)))
+        counters["output-digest"] = stages["output"]
+        dest = integrity.certificate_path()
+        if dest:
+            def write_cert():
+                from ..obs import sentinel as obs_sentinel
+                paths, _ = _resolve_inputs(cfg)
+                cert = integrity.run_certificate(
+                    input_signature=checkpoint.input_signature(paths),
+                    stages=stages, output_digest=stages["output"],
+                    provenance=obs_sentinel.provenance(),
+                    extra={"summary": summary, "n_cinds": len(table)})
+                integrity.write_certificate(dest, cert)
+            phases.run("write-certificate", write_cert)
 
 
 def _report(cfg: Config, counters: dict, timings: dict) -> None:

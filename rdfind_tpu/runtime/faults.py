@@ -100,6 +100,8 @@ SITES = (
     "host_pull",           # any host_gather/host_gather_many round trip
     "checkpoint_write",    # CheckpointStore.save
     "preempt@discover",    # pass-commit boundary of the pass executor
+    "flip@host_pull",      # silent corruption: one bit in a pulled block
+    "flip@snapshot",       # silent corruption: one bit in a loaded snapshot
 )
 
 
@@ -224,6 +226,27 @@ def overflow_injected(site: str, pass_idx: int | None = None) -> bool:
     """Whether an injected overflow verdict fires at `site` (bool form: the
     caller folds it into its psum'd overflow counters)."""
     return fires(site, pass_idx)
+
+
+def maybe_flip(site: str, arrays, pass_idx: int | None = None):
+    """Silent-corruption injection: when an armed ``flip@*`` fault fires,
+    flip ONE bit in the first non-empty array and return a new list (inputs
+    are never mutated — a re-pull must see clean data).  Unlike maybe_fail
+    nothing raises: the whole point is corruption that only the integrity
+    plane's digest verification can notice."""
+    if not fires(site, pass_idx):
+        return arrays
+    import numpy as np
+    out = list(arrays)
+    for i, a in enumerate(out):
+        a = np.asarray(a)
+        if a.size == 0:
+            continue
+        flat = a.copy().reshape(-1)
+        flat[0] = np.bitwise_xor(flat[0], flat.dtype.type(1))
+        out[i] = flat.reshape(a.shape)
+        break
+    return out
 
 
 def strict_mode() -> bool:

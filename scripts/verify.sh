@@ -91,6 +91,17 @@ elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/elastic_resume_parity.
     exit 1
 fi
 
+echo "== integrity parity (digests on/off, planted flip, verified resume) =="
+# The integrity plane must never change results: knob on/off bit-identical,
+# a planted bit flip detected+named+repaired, and an 8 -> 2 resume with
+# every snapshot pass digest-verified.  VERIFY_SKIP_INTEGRITY=1 opts out.
+if [ "${VERIFY_SKIP_INTEGRITY:-0}" = "1" ]; then
+    echo "verify: integrity parity skipped (VERIFY_SKIP_INTEGRITY=1)"
+elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/integrity_parity.py; then
+    echo "verify: integrity parity FAILED" >&2
+    exit 1
+fi
+
 if [ "${VERIFY_SKIP_BENCH:-0}" = "1" ]; then
     echo "verify: tier-1 green; bench + sentinel skipped (VERIFY_SKIP_BENCH=1)"
     exit 0

@@ -123,6 +123,30 @@ def test_progress_and_datastats_endpoints(live_console):
     assert ds["datastats_lines"]["n_lines"] == 4
 
 
+def test_integrity_endpoint(live_console):
+    """The integrity plane's console surface: /integrity serves exactly the
+    integrity* slice of the registry (stage digests, counters, events)."""
+    from rdfind_tpu.obs import integrity
+    integrity.publish_stage(None, "lines", 0x1234, 0x5678)
+    integrity.note_mismatch(None, site="host_pull", stage="pair-phase",
+                            pass_idx=1, repaired=True)
+    iv = _get_json(live_console, "/integrity")
+    assert all(k.startswith("integrity") for k in iv)
+    assert iv["integrity_stages"]["lines"] == integrity.digest_hex(
+        0x1234, 0x5678)
+    assert iv["integrity_verified"] >= 1
+    assert iv["integrity_events"][-1]["site"] == "host_pull"
+    index = _get_json(live_console, "/")
+    assert "/integrity" in index["endpoints"]
+
+
+def test_console_is_an_integrity_consumer(live_console):
+    """A live console alone arms the integrity plane (the same PR-5 gating
+    rule as datastats)."""
+    from rdfind_tpu.obs import integrity
+    assert integrity.enabled()
+
+
 def test_status_flightrec_index_and_404(live_console, tmp_path):
     status = _get_json(live_console, "/status")
     assert status["serving"] is True and status["pid"] == os.getpid()

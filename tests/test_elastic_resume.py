@@ -273,25 +273,33 @@ def _snap(parts, num_dev=8, n_pass=4):
                                        n_pass=n_pass)
 
 
+def _row(has, n_pass, *words):
+    """One host's vote payload: [has, n_pass, w0..w7] (bitmap words)."""
+    out = [float(has), float(n_pass)] + [0.0] * 8
+    for i, w in enumerate(words):
+        out[2 + i] = float(w)
+    return out
+
+
 def test_vote_full_agreement_resumes_intersection(monkeypatch):
     h = _VoteHarness()
     calls = _scripted_vote(monkeypatch, [
-        [[1, 4], [1, 4]],          # round 1: both hold n_pass=4 snapshots
-        [[1, 1, 0, 0], [1, 0, 0, 0]],  # round 2: peer only committed pass 0
+        # Both hold n_pass=4 snapshots; the peer only committed pass 0.
+        [_row(1, 4, 0b11), _row(1, 4, 0b01)],
     ])
     out = h._resolve_resume(_snap({0: "a", 1: "b"}), allow_adopt=True)
     assert sorted(out) == [0]
-    assert len(calls) == 2
-    assert calls[1] == [1.0, 1.0, 0.0, 0.0]  # our bitmap, under cand=4
-    assert h.stats["elastic_resume"]["vote_rounds"] == 2
+    assert len(calls) == 1  # the whole vote is one collective
+    assert calls[0] == _row(1, 4, 0b11)  # our bitmap: passes {0, 1}
+    assert h.stats["elastic_resume"]["vote_rounds"] == 1
     assert h.adopted is None
 
 
 def test_vote_missing_peer_shrinks_to_empty(monkeypatch):
     h = _VoteHarness()
     _scripted_vote(monkeypatch, [
-        [[1, 4], [0, 0]],              # peer lost its snapshot entirely
-        [[1, 1, 0, 0], [0, 0, 0, 0]],  # it contributes a zero bitmap
+        # Peer lost its snapshot: its zero bitmap empties the intersection.
+        [_row(1, 4, 0b11), _row(0, 0)],
     ])
     out = h._resolve_resume(_snap({0: "a", 1: "b"}), allow_adopt=True)
     assert out == {}
@@ -300,18 +308,20 @@ def test_vote_missing_peer_shrinks_to_empty(monkeypatch):
 def test_vote_partition_disagreement_is_full_rerun(monkeypatch):
     h = _VoteHarness()
     calls = _scripted_vote(monkeypatch, [
-        [[1, 4], [1, 6]],  # holders disagree: one file predates a split
+        # Holders disagree on n_pass: one file predates a split.
+        [_row(1, 4, 0b01), _row(1, 6, 0b01)],
     ])
     out = h._resolve_resume(_snap({0: "a"}), allow_adopt=True)
     assert out == {}
-    assert len(calls) == 1  # round 2 skipped deterministically on all hosts
+    assert len(calls) == 1
     assert h.stats["elastic_resume"]["vote_rounds"] == 1
 
 
-def test_vote_unadoptable_partition_skips_round_two(monkeypatch):
+def test_vote_unadoptable_partition_is_full_rerun(monkeypatch):
     h = _VoteHarness(n_pass=4)
     calls = _scripted_vote(monkeypatch, [
-        [[1, 8], [1, 8]],  # stored partition differs from this attempt's
+        # Stored partition differs from this attempt's and adoption is off.
+        [_row(1, 8, 0b01), _row(1, 8, 0b01)],
     ])
     out = h._resolve_resume(_snap({0: "a"}, n_pass=8), allow_adopt=False)
     assert out == {}
@@ -321,8 +331,7 @@ def test_vote_unadoptable_partition_skips_round_two(monkeypatch):
 def test_vote_adopts_common_partition(monkeypatch):
     h = _VoteHarness(n_pass=4)
     _scripted_vote(monkeypatch, [
-        [[1, 2], [1, 2]],
-        [[1, 1], [1, 1]],
+        [_row(1, 2, 0b11), _row(1, 2, 0b11)],
     ])
     out = h._resolve_resume(_snap({0: "a", 1: "b"}, n_pass=2),
                             allow_adopt=True)
@@ -333,9 +342,20 @@ def test_vote_adopts_common_partition(monkeypatch):
 
 def test_vote_no_holders_anywhere(monkeypatch):
     h = _VoteHarness()
-    calls = _scripted_vote(monkeypatch, [[[0, 0], [0, 0]]])
+    calls = _scripted_vote(monkeypatch, [[_row(0, 0), _row(0, 0)]])
     assert h._resolve_resume(None, allow_adopt=True) == {}
     assert len(calls) == 1  # the vote still ran: no host may skip it
+    assert calls[0] == _row(0, 0)
+
+
+def test_vote_oversized_partition_votes_no_snapshot(monkeypatch):
+    # Eight 32-bit words cap the bitmap at 256 passes; a larger stored
+    # partition must vote has=0 (full re-run), never a torn bitmap.
+    h = _VoteHarness(n_pass=300)
+    calls = _scripted_vote(monkeypatch, [[_row(0, 0), _row(0, 0)]])
+    out = h._resolve_resume(_snap({0: "a"}, n_pass=300), allow_adopt=True)
+    assert out == {}
+    assert calls[0] == _row(0, 0)
 
 
 # ---------------------------------------------------------------------------

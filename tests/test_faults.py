@@ -257,6 +257,13 @@ _CHAOS_SPECS = {
     "host_pull": "host_pull:nth=4;host_pull:nth=9",
     "checkpoint_write": "checkpoint_write:times=-1",
     "preempt@discover": "preempt@discover:pass=1",
+    # The bit-flip sites only fire inside the integrity plane's verify
+    # hooks (test enables RDFIND_INTEGRITY below): a one-shot pull flip is
+    # repaired by re-pull, and flip@snapshot stays armed-and-unfired here
+    # (no resume in this sweep) — named-detection coverage lives in
+    # test_integrity.py's flip sweep.
+    "flip@host_pull": "flip@host_pull:nth=1",
+    "flip@snapshot": "flip@snapshot:times=1",
 }
 
 
@@ -282,6 +289,8 @@ def test_chaos_sweep_every_site(mesh8, tmp_path, monkeypatch, site,
     must still produce bit-identical CIND tables vs the fault-free run."""
     triples = _workload()
     monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    if site.startswith("flip"):
+        monkeypatch.setenv("RDFIND_INTEGRITY", "1")
     for name, fn in _SHARDED_STRATEGIES:
         prog_dir = tmp_path / site.replace("@", "_") / name
         _arm(monkeypatch, _CHAOS_SPECS[site])

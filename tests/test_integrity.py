@@ -1,0 +1,413 @@
+"""The integrity plane: order/mesh-invariant stage digests, silent-corruption
+detection, and digest-attested resume.
+
+Fast tier: the host fold vs the device digest_fold lanes bit for bit, digest
+algebra units (order invariance, flip sensitivity, the sketch psum identity),
+stage-digest mesh invariance (8 vs 2), the knob-off bit-identity matrix over
+all four sharded strategies, a digest-verified shrink resume, one repaired
+pull flip, strict-mode failure, the run-certificate helpers, and the
+disabled-path <2% bound.  Slow tier: mesh 1 in the invariance set and the
+grow-direction verified resume.  Chaos tier: every registered flip site x all
+four sharded strategies — each injected bit flip must be DETECTED AND NAMED
+(site + pass) with the output still bit-identical in default mode.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rdfind_tpu.models import allatonce, sharded
+from rdfind_tpu.obs import integrity
+from rdfind_tpu.ops import hashing
+from rdfind_tpu.parallel.mesh import make_mesh
+from rdfind_tpu.runtime import checkpoint, faults
+from rdfind_tpu.utils.synth import generate_triples
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 CPU devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("RDFIND_FAULTS", raising=False)
+    monkeypatch.delenv("RDFIND_INTEGRITY", raising=False)
+    monkeypatch.delenv("RDFIND_INTEGRITY_STRICT", raising=False)
+    monkeypatch.setenv("RDFIND_BACKOFF_BASE_MS", "1")
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv("RDFIND_FAULTS", spec)
+    faults.reset()
+
+
+def _disarm(monkeypatch):
+    monkeypatch.delenv("RDFIND_FAULTS", raising=False)
+    faults.reset()
+
+
+def _workload():
+    # Same shape as test_faults/test_elastic_resume: shares the fast tier's
+    # process-wide jit cache.
+    return generate_triples(300, seed=21, n_predicates=8, n_entities=32)
+
+
+def _progress(tmp_path, name="p"):
+    return checkpoint.ProgressStore(
+        checkpoint.CheckpointStore(str(tmp_path / name)), "base")
+
+
+# ---------------------------------------------------------------------------
+# Digest algebra: host fold == device fold, order/mesh invariance, and the
+# flip sensitivity every verify hook relies on.
+# ---------------------------------------------------------------------------
+
+
+def test_host_fold_matches_device_digest_fold():
+    """obs/integrity's numpy fold must reproduce ops.hashing.digest_fold bit
+    for bit — the host replica is what re-verifies pulled blocks and loaded
+    snapshots against the device lanes."""
+    rng = np.random.default_rng(3)
+    n = 133
+    cols = [rng.integers(-2**31, 2**31 - 1, size=n).astype(np.int32)
+            for _ in range(4)]
+    valid = rng.random(n) < 0.7
+    for seed in (integrity.SEED_A, integrity.SEED_B, 0, 7):
+        dev = int(hashing.digest_fold(
+            [jnp.asarray(c) for c in cols], jnp.asarray(valid),
+            seed=seed)) & integrity.MASK32
+        host = integrity._fold([c[valid] for c in cols], seed)
+        assert dev == host, seed
+
+
+def test_digest_rows_order_invariant_and_flip_sensitive():
+    rng = np.random.default_rng(4)
+    cols = [rng.integers(0, 1000, size=64).astype(np.int64)
+            for _ in range(3)]
+    perm = rng.permutation(64)
+    assert integrity.digest_rows(cols) == integrity.digest_rows(
+        [c[perm] for c in cols])
+    flipped = [c.copy() for c in cols]
+    flipped[1][17] ^= 1
+    assert integrity.digest_rows(cols) != integrity.digest_rows(flipped)
+
+
+def test_sketch_digest_is_sum_of_partial_digests():
+    """The mesh-invariance identity for the dense count-min layout: the
+    digest of D stacked per-device partials equals the wraparound sum of the
+    per-partial digests — exactly what the device lanes psum."""
+    rng = np.random.default_rng(5)
+    bits = 64
+    partials = [rng.integers(0, 100, size=bits).astype(np.int32)
+                for _ in range(8)]
+    whole = integrity.digest_sketch_rows(np.concatenate(partials), bits)
+    per = [integrity.digest_sketch_rows(p, bits) for p in partials]
+    summed = (sum(a for a, _ in per) & integrity.MASK32,
+              sum(b for _, b in per) & integrity.MASK32)
+    assert whole == summed
+
+
+def test_lanes_roundtrip_and_hex():
+    a, b = integrity.digest_rows([np.arange(5)])
+    ia = np.int32(np.uint32(a))  # as the telemetry lanes carry it
+    ib = np.int32(np.uint32(b))
+    assert integrity.lanes_to_digest(ia, ib) == (a, b)
+    assert integrity.digest_hex(a, b) == f"{a:08x}{b:08x}"
+
+
+def test_enabled_knob_policy(monkeypatch):
+    monkeypatch.setenv("RDFIND_INTEGRITY", "0")
+    assert not integrity.enabled()
+    monkeypatch.setenv("RDFIND_INTEGRITY", "1")
+    assert integrity.enabled()
+    monkeypatch.delenv("RDFIND_INTEGRITY")
+    assert not integrity.enabled()  # no obs consumer live under pytest
+
+
+# ---------------------------------------------------------------------------
+# Stage digests: mesh invariance and the knob-off bit-identity matrix.
+# ---------------------------------------------------------------------------
+
+_SHARDED_STRATEGIES = (
+    ("allatonce", sharded.discover_sharded),
+    ("small_to_large", sharded.discover_sharded_s2l),
+    ("approximate", sharded.discover_sharded_approx),
+    ("late_bb", sharded.discover_sharded_late_bb),
+)
+
+
+def _stages(triples, mesh, monkeypatch):
+    monkeypatch.setenv("RDFIND_INTEGRITY", "1")
+    stats: dict = {}
+    table = sharded.discover_sharded(triples, 2, mesh=mesh, stats=stats)
+    return dict(stats["integrity_stages"]), table
+
+
+def test_stage_digests_mesh_invariant_8_vs_2(mesh8, monkeypatch):
+    """The same logical row set digests identically at mesh 8 and mesh 2 —
+    the property PR-14's cross-mesh snapshot verification rests on."""
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    s8, t8 = _stages(triples, mesh8, monkeypatch)
+    s2, t2 = _stages(triples, make_mesh(2), monkeypatch)
+    assert set(s8) >= {"lines", "captures", "cind", "output"}
+    assert s8 == s2
+    assert t8.to_rows() == t2.to_rows()
+    # The output stage is the CindTable digest — pin it to the independent
+    # single-device reference.
+    ref = allatonce.discover(triples, 2)
+    assert s8["output"] == integrity.digest_hex(*integrity.digest_table(ref))
+
+
+@pytest.mark.slow
+def test_stage_digests_mesh_invariant_at_mesh_1(mesh8, monkeypatch):
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    s8, _ = _stages(triples, mesh8, monkeypatch)
+    s1, _ = _stages(triples, make_mesh(1), monkeypatch)
+    assert s8 == s1
+
+
+def test_knob_off_bit_identity_matrix(mesh8, monkeypatch):
+    """RDFIND_INTEGRITY=0 must be bit-identical to =1 for every sharded
+    strategy (the device lanes are computed unconditionally; only host-side
+    verification is gated), and the off runs publish no integrity stats."""
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    for name, fn in _SHARDED_STRATEGIES:
+        monkeypatch.setenv("RDFIND_INTEGRITY", "0")
+        s_off: dict = {}
+        off = fn(triples, 2, mesh=mesh8, stats=s_off)
+        monkeypatch.setenv("RDFIND_INTEGRITY", "1")
+        s_on: dict = {}
+        on = fn(triples, 2, mesh=mesh8, stats=s_on)
+        assert off.to_rows() == on.to_rows(), name
+        assert "integrity_stages" not in s_off, name
+        assert s_on["integrity_stages"]["output"] == integrity.digest_hex(
+            *integrity.digest_table(on)), name
+        assert s_on.get("integrity_mismatches", 0) == 0, name
+
+
+# ---------------------------------------------------------------------------
+# Digest-attested resume: verified on load, across mesh changes.
+# ---------------------------------------------------------------------------
+
+
+def test_shrink_resume_verifies_snapshot_digests(mesh8, tmp_path,
+                                                 monkeypatch):
+    """Preempt at mesh 8, resume at mesh 2 with integrity on: every loaded
+    pass re-verifies AFTER the re-shard (the digest is order-invariant, so
+    the permutation washes out) and the table stays bit-identical."""
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    ref = allatonce.discover(triples, 2)
+    _arm(monkeypatch, "preempt@discover:pass=1")
+    with pytest.raises(faults.Preempted):
+        sharded.discover_sharded(triples, 2, mesh=mesh8,
+                                 progress=_progress(tmp_path))
+    _disarm(monkeypatch)
+    monkeypatch.setenv("RDFIND_INTEGRITY", "1")
+    stats: dict = {}
+    table = sharded.discover_sharded(triples, 2, mesh=make_mesh(2),
+                                     stats=stats,
+                                     progress=_progress(tmp_path))
+    assert stats["resumed_passes"] == 2
+    assert stats.get("integrity_mismatches", 0) == 0
+    assert stats["integrity_verified"] > 0
+    assert table.to_rows() == ref.to_rows()
+
+
+@pytest.mark.slow
+def test_grow_resume_verifies_snapshot_digests(tmp_path, monkeypatch):
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    ref = allatonce.discover(triples, 2)
+    _arm(monkeypatch, "preempt@discover:pass=1")
+    with pytest.raises(faults.Preempted):
+        sharded.discover_sharded(triples, 2, mesh=make_mesh(1),
+                                 progress=_progress(tmp_path))
+    _disarm(monkeypatch)
+    monkeypatch.setenv("RDFIND_INTEGRITY", "1")
+    stats: dict = {}
+    table = sharded.discover_sharded(triples, 2, mesh=make_mesh(8),
+                                     stats=stats,
+                                     progress=_progress(tmp_path))
+    assert stats["resumed_passes"] == 2
+    assert stats.get("integrity_mismatches", 0) == 0
+    assert table.to_rows() == ref.to_rows()
+
+
+def test_snapshot_flip_is_clean_miss(mesh8, tmp_path, monkeypatch):
+    """A bit flipped in a loaded snapshot pass is detected by the stored
+    digest lanes; the pass becomes a clean miss (re-run, bit-identical
+    output) with a NAMED integrity event — never a corrupted resume."""
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    ref = allatonce.discover(triples, 2)
+    _arm(monkeypatch, "preempt@discover:pass=1")
+    with pytest.raises(faults.Preempted):
+        sharded.discover_sharded(triples, 2, mesh=mesh8,
+                                 progress=_progress(tmp_path))
+    monkeypatch.setenv("RDFIND_INTEGRITY", "1")
+    _arm(monkeypatch, "flip@snapshot:times=1")
+    stats: dict = {}
+    table = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats,
+                                     progress=_progress(tmp_path))
+    events = [e for e in stats["integrity_events"]
+              if e["site"] == "snapshot"]
+    assert events and "pass" in events[0] and not events[0]["repaired"]
+    assert any(d["action"] == "integrity_miss"
+               for d in stats["degradations"])
+    assert stats["resumed_passes"] == 1  # the flipped pass was dropped
+    assert table.to_rows() == ref.to_rows()
+
+
+# ---------------------------------------------------------------------------
+# Host-pull verification: transient flips repair, strict mode fails fast.
+# ---------------------------------------------------------------------------
+
+
+def test_pull_flip_detected_and_repaired(mesh8, monkeypatch):
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    ref = allatonce.discover(triples, 2)
+    monkeypatch.setenv("RDFIND_INTEGRITY", "1")
+    _arm(monkeypatch, "flip@host_pull:nth=1")
+    stats: dict = {}
+    table = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    events = [e for e in stats["integrity_events"]
+              if e["site"] == "host_pull"]
+    assert events and events[0]["repaired"] and "pass" in events[0]
+    assert stats["integrity_repaired"] == 1
+    assert table.to_rows() == ref.to_rows()  # the re-pull repaired it
+
+
+def test_strict_mode_fails_the_run_on_flip(mesh8, monkeypatch):
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    monkeypatch.setenv("RDFIND_INTEGRITY", "1")
+    monkeypatch.setenv("RDFIND_INTEGRITY_STRICT", "1")
+    _arm(monkeypatch, "flip@host_pull:nth=1")
+    with pytest.raises(integrity.IntegrityError):
+        sharded.discover_sharded(triples, 2, mesh=mesh8, stats={})
+
+
+# ---------------------------------------------------------------------------
+# The run certificate and the disabled-path cost bound.
+# ---------------------------------------------------------------------------
+
+
+def test_run_certificate_roundtrip(tmp_path):
+    cert = integrity.run_certificate(
+        input_signature={"n": 1}, stages={"output": "00ab"},
+        output_digest="00ab", provenance={"n_cores": 8},
+        extra={"n_cinds": 3})
+    path = tmp_path / "cert.json"
+    integrity.write_certificate(str(path), cert)
+    got = json.loads(path.read_text())
+    assert got["format"] == 1
+    assert got["output_digest"] == "00ab"
+    assert got["stages"] == {"output": "00ab"}
+    assert got["n_cinds"] == 3
+    assert not list(tmp_path.glob("*.tmp.*"))  # atomic: no tmp left behind
+
+
+def test_certificate_path_resolution(tmp_path, monkeypatch):
+    from rdfind_tpu.obs import tracer
+    monkeypatch.delenv("RDFIND_CERT", raising=False)
+    assert integrity.certificate_path() is None  # no trace dir under pytest
+    monkeypatch.setattr(tracer, "trace_dir", lambda: str(tmp_path))
+    assert integrity.certificate_path() == str(
+        tmp_path / "run_certificate.json")
+    monkeypatch.setenv("RDFIND_CERT", str(tmp_path / "c.json"))
+    assert integrity.certificate_path() == str(tmp_path / "c.json")
+
+
+def test_disabled_integrity_overhead_under_2pct(mesh8, monkeypatch):
+    """The acceptance bound, measured like test_obs's disabled-tracing
+    bound: (cost of the disabled-path gate) x (gate hits per run) must stay
+    under 2% of the pipeline's wall clock.  With the knob off the device
+    lanes are part of the one compiled program (bit-identity guarantees
+    they were already) and the host side is a resolved-once boolean plus
+    one per-pass branch."""
+    monkeypatch.setenv("RDFIND_INTEGRITY", "0")
+    triples = generate_triples(300, seed=5, n_predicates=8, n_entities=32)
+    stats: dict = {}
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)  # warm
+    stats = {}
+    t0 = time.perf_counter()
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    wall_s = time.perf_counter() - t0
+
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        integrity.enabled()
+    per_hit_s = (time.perf_counter() - t0) / n
+    # Per phase: one enabled() resolve; per pass: one attribute branch
+    # (bounded above by a full enabled() call); generous 4x headroom.
+    hits = 4 * (2 + max(stats.get("n_pair_passes", 1), 1))
+    overhead = hits * per_hit_s
+    assert overhead / wall_s < 0.02, (
+        f"disabled integrity path costs {overhead * 1e3:.3f}ms over "
+        f"{wall_s * 1e3:.0f}ms wall ({overhead / wall_s:.2%})")
+
+
+# ---------------------------------------------------------------------------
+# Chaos tier: every registered flip site x all four sharded strategies is
+# detected AND named (site + pass) before the output commits.
+# ---------------------------------------------------------------------------
+
+_FLIP_SITES = ("flip@host_pull", "flip@snapshot")
+
+
+@pytest.fixture(scope="module")
+def flip_free_tables(mesh8):
+    mp = pytest.MonkeyPatch()
+    mp.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    try:
+        triples = _workload()
+        return {name: fn(triples, 2, mesh=mesh8).to_rows()
+                for name, fn in _SHARDED_STRATEGIES}
+    finally:
+        mp.undo()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("site", _FLIP_SITES)
+def test_flip_sweep_detects_and_names(mesh8, tmp_path, monkeypatch, site,
+                                      flip_free_tables):
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    monkeypatch.setenv("RDFIND_INTEGRITY", "1")
+    site_name = site.split("@", 1)[1]
+    for name, fn in _SHARDED_STRATEGIES:
+        prog_dir = tmp_path / site.replace("@", "_") / name
+        if site == "flip@snapshot":
+            # The snapshot site only fires on a resume: preempt first.
+            _arm(monkeypatch, "preempt@discover:pass=0")
+            with pytest.raises(faults.Preempted):
+                fn(triples, 2, mesh=mesh8, progress=_progress(prog_dir))
+            _arm(monkeypatch, "flip@snapshot:times=1")
+        else:
+            _arm(monkeypatch, "flip@host_pull:nth=1")
+        stats: dict = {}
+        table = fn(triples, 2, mesh=mesh8, stats=stats,
+                   progress=_progress(prog_dir))
+        _disarm(monkeypatch)
+        events = [e for e in stats.get("integrity_events", [])
+                  if e["site"] == site_name]
+        assert events, (site, name)
+        assert "pass" in events[0] and events[0]["stage"], (site, name)
+        assert table.to_rows() == flip_free_tables[name], (site, name)

@@ -378,6 +378,40 @@ def test_tpu_watch_status_degrading(tmp_path):
     assert payload["hosts"]["0"]["forecast"]["cap"] == "pairs"
 
 
+def test_tpu_watch_status_corrupt(tmp_path):
+    """Satellite: an unrepaired integrity mismatch on the heartbeat is a
+    per-host CORRUPT verdict with its own exit code 3, distinct from wedged
+    (1) / missing (2) and outranking both."""
+    import subprocess
+    import sys
+
+    d = str(tmp_path)
+    heartbeat.write(d, {
+        "stage": "pair-phase", "pass": 2,
+        "integrity": {"corrupt": True, "site": "host_pull",
+                      "stage": "pair-phase"}}, host_index=0)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 3, (r.stdout, r.stderr)
+    assert "CORRUPT" in r.stdout and "host_pull" in r.stdout
+    # Corrupt outranks wedged: a stale AND corrupt run still exits 3.
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d,
+         "--stale-s", "0"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 3
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d,
+         "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 3
+    payload = json.loads(r.stdout)
+    assert payload["corrupt"] is True
+    assert payload["hosts"]["0"]["integrity"]["site"] == "host_pull"
+
+
 # ---------------------------------------------------------------------------
 # Disabled-path overhead.
 # ---------------------------------------------------------------------------

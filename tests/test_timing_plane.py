@@ -319,6 +319,44 @@ def test_sentinel_check_verdict_statuses(tmp_path):
     assert "headline_wall_s" in v["regressions"]
 
 
+def _dig_result(dig, wall=1.0, workload=None):
+    return {"value": 500.0, "detail": {
+        "wall_s": wall, "output_digest": dig,
+        "workload": workload or {"n_triples": 300}}}
+
+
+def test_sentinel_digest_change_is_correctness_regression(tmp_path):
+    """Satellite (integrity plane): an output-digest change at an unchanged
+    provenance key + workload is a CORRECTNESS regression — flagged with no
+    threshold or spread, independent of the perf metrics."""
+    hist = str(tmp_path / "hist.jsonl")
+    for _ in range(3):
+        sentinel.append(_dig_result("aa"), path=hist, backend="cpu")
+    v = sentinel.check_verdict(path=hist)
+    assert v["ok"] and v["correctness"]["regressed"] is False
+    # Identical perf, different digest: correctness regresses, perf doesn't.
+    sentinel.append(_dig_result("bb"), path=hist, backend="cpu")
+    v = sentinel.check_verdict(path=hist)
+    assert not v["ok"] and "output_digest" in v["regressions"]
+    assert all(not m["regressed"] for m in v["metrics"].values())
+    ok, lines = sentinel.check(path=hist)
+    assert not ok
+    assert any("CORRECTNESS REGRESSION" in ln for ln in lines)
+
+
+def test_sentinel_digests_compare_same_workload_only(tmp_path):
+    """The tiny verify.sh bench and a full bench share a provenance key but
+    not a workload: their digests must never cross-compare."""
+    hist = str(tmp_path / "hist.jsonl")
+    sentinel.append(_dig_result("aa", workload={"n_triples": 300}),
+                    path=hist, backend="cpu")
+    sentinel.append(_dig_result("bb", workload={"n_triples": 600}),
+                    path=hist, backend="cpu")
+    v = sentinel.check_verdict(path=hist)
+    assert v["ok"]
+    assert v["correctness"]["baseline_digests"] == []
+
+
 def test_sentinel_cli_json(tmp_path):
     """Satellite: --check --json emits ONE machine-readable verdict line
     with exit-code parity against the prose mode."""

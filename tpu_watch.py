@@ -28,7 +28,9 @@ rdfind_tpu.obs.heartbeat, so "is the watcher wedged inside a bench or just
 sleeping between probes" is answerable without reading the log.  The same
 machinery reads any RUN's obs directory back: ``tpu_watch.py --status DIR``
 prints alive/wedged/done (+ the stage/pass the run is inside) and exits
-0/1/2 — the wedged-vs-slow verdict for traced rdfind runs (--trace DIR).
+0/1/2 — the wedged-vs-slow verdict for traced rdfind runs (--trace DIR) —
+or 3 (CORRUPT) when a host's heartbeat carries an unrepaired integrity
+digest mismatch (the run may be moving, but its output is not attested).
 """
 
 import argparse
@@ -206,24 +208,43 @@ def _degrading_hosts(hosts: dict) -> dict:
             if isinstance(b.get("forecast"), dict)}
 
 
+def _corrupt_hosts(hosts: dict) -> dict:
+    """{host: integrity-verdict} for hosts whose heartbeat carries an
+    unrepaired integrity-digest mismatch (obs/integrity.note_mismatch pushes
+    it onto the run status).  CORRUPT is distinct from both "wedged" (the
+    run may still be moving) and "degrading" (a cap forecast): the output
+    of this run can no longer be trusted bit-for-bit."""
+    return {h: b["integrity"] for h, b in hosts.items()
+            if isinstance(b.get("integrity"), dict)
+            and b["integrity"].get("corrupt")}
+
+
 def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
     """The wedged-vs-slow verdict over a run's obs directory (exit codes:
-    0 alive/done, 1 wedged, 2 no heartbeat at all; "degrading" is reported
-    but never changes the exit code — the run is still making progress)."""
+    0 alive/done, 1 wedged, 2 no heartbeat at all, 3 CORRUPT — an
+    unrepaired integrity mismatch on some host's heartbeat; "degrading" is
+    reported but never changes the exit code — the run is still making
+    progress)."""
     verdict = heartbeat.assess(obs_dir, stale_s=stale_s)
     state = verdict["state"]
     hosts = {
         h: {**b, "stale": b["age_s"] > stale_s and not b.get("final")}
         for h, b in verdict["hosts"].items()}
     degrading = _degrading_hosts(hosts)
+    corrupt = _corrupt_hosts(hosts)
     recs = _flightrec_summaries(obs_dir)
     if as_json:
         print(json.dumps({"dir": obs_dir, "state": state,
                           "degrading": bool(degrading),
+                          "corrupt": bool(corrupt),
                           "stale_s": stale_s, "age_s": verdict["age_s"],
                           "hosts": hosts, "flightrec": recs},
                          sort_keys=True, default=str))
-        return 2 if state == "missing" else (1 if state == "wedged" else 0)
+        if state == "missing":
+            return 2
+        if corrupt:
+            return 3
+        return 1 if state == "wedged" else 0
     if state == "missing":
         print(f"status[{obs_dir}]: no heartbeat files "
               f"(not a traced run directory, or the run never started)")
@@ -248,6 +269,11 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
                   f"{fc.get('cap')} forecast exhausted at pass "
                   f"{fc.get('predicted_pass')} ({fc.get('reason')}, frac "
                   f"{fc.get('frac')})")
+        iv = corrupt.get(h)
+        if iv is not None:
+            print(f"status[{obs_dir}] host {h}: CORRUPT — integrity digest "
+                  f"mismatch at {iv.get('site')} ({iv.get('stage')}); the "
+                  f"output is not digest-attested")
     # Surface the wedged host's flight recorder when one was dumped: the
     # ring of events leading into the stall, captured even with the jsonl
     # tracer off.
@@ -260,13 +286,18 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
               f"({r['n_events']} events, reason={r['reason']!r}) at "
               f"{r['path']}; last: {', '.join(map(str, r['last_events']))}")
     tail = ""
-    if state == "wedged":
+    if corrupt:
+        tail = (f" (CORRUPT: unrepaired integrity mismatch on host(s) "
+                f"{sorted(corrupt)})")
+    elif state == "wedged":
         tail = f" (no span boundary for > {stale_s:.0f}s — wedged, not slow)"
     elif degrading:
         tail = (" (degrading: cap-exhaustion forecast active on host(s) "
                 f"{sorted(degrading)} — alive, but the degradation ladder "
                 "is imminent)")
     print(f"status[{obs_dir}]: {state}" + tail)
+    if corrupt:
+        return 3
     return 1 if state == "wedged" else 0
 
 
