@@ -1029,13 +1029,10 @@ def _host_mix32(x: np.ndarray) -> np.ndarray:
 def _host_bucket_of(cols, num_buckets: int, *, seed: int) -> np.ndarray:
     """numpy replica of ops.hashing.bucket_of: the elastic-resume re-shard
     must route reloaded rows to exactly the owners the device exchanges
-    would pick, or a resumed run would diverge from an uninterrupted one."""
-    with np.errstate(over="ignore"):
-        h = np.uint32(0x9E3779B9 * (seed + 1) & 0xFFFFFFFF)
-        for c in cols:
-            h = _host_mix32(np.asarray(c).astype(np.uint32)
-                            ^ (h + np.uint32(0x9E3779B9)))
-        return (h % np.uint32(num_buckets)).astype(np.int32)
+    would pick, or a resumed run would diverge from an uninterrupted one.
+    The replica now lives in ops.hashing.host_bucket_of so the delta engine
+    shares the identical routing law; this wrapper keeps the local name."""
+    return hashing.host_bucket_of(cols, num_buckets, seed=seed)
 
 
 def _reshard_pass_rows(cols, num_dev: int):

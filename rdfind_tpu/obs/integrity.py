@@ -100,18 +100,30 @@ def _mix32(x):
     return x ^ (x >> np.uint32(16))
 
 
-def _fold(cols, seed: int) -> int:
-    """Wraparound-uint32 sum of the per-row hash_cols mixes of `cols`."""
+def row_mixes(cols, seed: int):
+    """Per-row hash_cols mixes (uint32 array) — the summands of _fold.
+
+    Exposed so incremental consumers (runtime/delta.py's per-pass digest
+    maintenance) can subtract removed rows and add inserted rows from a
+    stored lane sum in O(change): the lanes are plain mod-2^32 sums of
+    these mixes, so a digest update never needs the unchanged rows.
+    """
     import numpy as np
     with np.errstate(over="ignore"):
         h = np.uint32(0x9E3779B9 * (seed + 1) & MASK32)
         for c in cols:
             h = _mix32(np.asarray(c).astype(np.uint32)
                        ^ (h + np.uint32(0x9E3779B9)))
-        h = np.asarray(h, np.uint32)
-        if h.ndim == 0:
-            return int(h)
-        return int(np.sum(h.reshape(-1), dtype=np.uint32))
+        return np.asarray(h, np.uint32)
+
+
+def _fold(cols, seed: int) -> int:
+    """Wraparound-uint32 sum of the per-row hash_cols mixes of `cols`."""
+    import numpy as np
+    h = row_mixes(cols, seed)
+    if h.ndim == 0:
+        return int(h)
+    return int(np.sum(h.reshape(-1), dtype=np.uint32))
 
 
 def digest_rows(cols) -> tuple[int, int]:

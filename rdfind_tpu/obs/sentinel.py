@@ -132,6 +132,21 @@ METRIC_SPECS = (
      ("detail", "half_approx", "sketch_reduce", "dcn_bytes_flat"), "lower"),
     ("half_approx_sketch_dcn_bytes_hier",
      ("detail", "half_approx", "sketch_reduce", "dcn_bytes_hier"), "lower"),
+    # Incremental-discovery rows (bench_delta.py): full-rerun wall over
+    # --delta wall per change-batch size, plus the fraction of the pass
+    # partition the 1% batch had to re-run (the "time proportional to the
+    # change" claim made falsifiable — a regression here means the dirty
+    # set stopped being sparse).
+    ("delta_speedup_01pct",
+     ("detail", "delta", "d01pct", "delta_speedup"), "higher"),
+    ("delta_speedup_1pct",
+     ("detail", "delta", "d1pct", "delta_speedup"), "higher"),
+    ("delta_speedup_10pct",
+     ("detail", "delta", "d10pct", "delta_speedup"), "higher"),
+    ("delta_frac_passes_rerun_1pct",
+     ("detail", "delta", "d1pct", "frac_passes_rerun"), "lower"),
+    ("delta_wall_1pct_s",
+     ("detail", "delta", "d1pct", "delta_wall_s"), "lower"),
 )
 _DIRECTIONS = {name: d for name, _, d in METRIC_SPECS}
 
@@ -175,6 +190,15 @@ def _dig(result: dict, path: tuple):
 def extract_metrics(result: dict) -> dict[str, float]:
     out = {}
     for name, path, _direction in METRIC_SPECS:
+        if path == ("value",) and result.get(
+                "metric") != "cind_pairs_checked_per_sec_per_chip":
+            # The top-level value is only the pairs/s headline on a full
+            # bench.py row.  Promoted standalone rows (ingest-only,
+            # kernel-modes, bench_delta) reuse the slot for a different
+            # unit under the SAME provenance key — recording it as the
+            # headline would fake a regression against the real headline
+            # baseline.  Their numbers ride their own detail.* specs.
+            continue
         v = _dig(result, path)
         if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
             out[name] = float(v)

@@ -34,6 +34,28 @@ def bucket_of(cols, num_buckets, seed: int = 0):
     return (hash_cols(cols, seed) % jnp.uint32(num_buckets)).astype(jnp.int32)
 
 
+def host_bucket_of(cols, num_buckets: int, seed: int = 0):
+    """Numpy replica of bucket_of — bit-identical to the device law.
+
+    This is the single routing law shared by the sharded exchange planner,
+    the elastic-resume re-shard (models/sharded.py delegates its host
+    replica here), and the delta engine's bucket ownership map
+    (runtime/delta.py): a value hashes to the same bucket on device, on a
+    resumed mesh, and in an incremental run, so "which bucket owns this
+    join value" has exactly one answer everywhere.
+    """
+    import numpy as np
+    with np.errstate(over="ignore"):
+        h = np.uint32(0x9E3779B9 * (seed + 1) & 0xFFFFFFFF)
+        for c in cols:
+            x = np.asarray(c).astype(np.uint32) ^ (h + np.uint32(0x9E3779B9))
+            x = (x ^ (x >> np.uint32(16))) * np.uint32(0x85EBCA6B)
+            x = (x ^ (x >> np.uint32(13))) * np.uint32(0xC2B2AE35)
+            h = x ^ (x >> np.uint32(16))
+        return (np.asarray(h, np.uint32) % np.uint32(num_buckets)).astype(
+            np.int32)
+
+
 def digest_fold(cols, valid, seed: int = 0):
     """One order-invariant content-digest lane over a masked row set: the
     per-row hash_cols mixes, invalid rows zeroed, summed mod 2^32.

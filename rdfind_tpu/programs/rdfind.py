@@ -28,7 +28,27 @@ def build_parser() -> argparse.ArgumentParser:
         prog="rdfind-tpu",
         description="Discover Conditional Inclusion Dependencies in RDF datasets "
                     "(TPU-native rebuild of stratosphere/rdfind).")
-    p.add_argument("inputs", nargs="+", help="input .nt/.nq[.gz] files or globs")
+    p.add_argument("inputs", nargs="*",
+                   help="input .nt/.nq[.gz] files or globs (for --delta "
+                        "runs these are the INSERT batch; may be empty for "
+                        "a delete-only batch)")
+    p.add_argument("--delta", default=None, metavar="BASE_DIR",
+                   dest="delta_base",
+                   help="incremental run: apply the insert (positional) and "
+                        "--deletes batches against the base bundle in "
+                        "BASE_DIR (written by a full run with "
+                        "--delta-state); output is bit-identical to a "
+                        "from-scratch run on the updated dataset, and the "
+                        "bundle advances one generation in place")
+    p.add_argument("--delta-state", default=None, metavar="DIR",
+                   help="full run: persist the delta base bundle (interned "
+                        "dictionary + per-bucket join-line rows + per-pass "
+                        "digests + the definitional CIND set) into DIR for "
+                        "later --delta runs")
+    p.add_argument("--deletes", nargs="*", default=[], metavar="FILE",
+                   help="delete batch files for a --delta run (same formats "
+                        "as the inputs; each line retracts one matching "
+                        "triple)")
     p.add_argument("--prefixes", nargs="*", default=[],
                    help="nt-prefix files for URL shortening")
     p.add_argument("--support", type=int, default=10,
@@ -193,6 +213,26 @@ def main(argv=None) -> int:
         # output) — a long-standing footgun.
         parser.error(f"--projection {args.projection!r} must be a non-empty "
                      f"subset of 'spo'")
+    if args.deletes and not args.delta_base:
+        parser.error("--deletes only applies to incremental runs; pass "
+                     "--delta BASE_DIR")
+    if not args.inputs and not (args.delta_base and args.deletes):
+        parser.error("no input files (positional inputs may only be empty "
+                     "for a delete-only --delta run with --deletes)")
+    if args.delta_base:
+        for flag, bad in (("--sharded-ingest", args.sharded_ingest),
+                          ("--only-read", args.only_read),
+                          ("--do-only-join", args.only_join),
+                          ("--find-only-fcs", args.find_only_fcs),
+                          ("--checkpoint-dir", args.checkpoint_dir)):
+            if bad:
+                parser.error(f"{flag} is not supported with --delta "
+                             f"(the delta engine replays host-side against "
+                             f"the base bundle)")
+        if args.delta_state:
+            print("note: --delta-state is ignored with --delta (the run "
+                  "advances the base bundle in place)", file=sys.stderr)
+            args.delta_state = None
     if args.dop > 1 and args.coordinator is None and \
             "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
@@ -275,6 +315,9 @@ def main(argv=None) -> int:
         trace_dir=args.trace_dir,
         metrics_file=args.metrics_file,
         console_port=args.console_port,
+        delta_base=args.delta_base,
+        delta_state=args.delta_state,
+        delete_paths=args.deletes,
     )
     # Un-silence the remaining compatibility no-ops (the reference's
     # JVM-dataflow levers that the TPU design subsumes).
@@ -306,8 +349,16 @@ def main(argv=None) -> int:
                   file=sys.stderr)
     from ..runtime import faults
 
+    from ..runtime import delta as delta_rt
+
     try:
         result = driver.run(cfg)
+    except delta_rt.DeltaBaseError as e:
+        # Clean miss, never a wrong incremental answer: name the failure
+        # and tell the caller how to rebuild.
+        print(f"rdfind: delta base unusable ({e}); re-run a full build "
+              f"with --delta-state to rebuild the bundle", file=sys.stderr)
+        return 66  # EX_NOINPUT: the base bundle cannot serve this run
     except faults.Preempted as e:
         # Injected (or test-driven) preemption: in-flight progress was
         # flushed before the raise; the same command resumes the run.

@@ -79,6 +79,12 @@ class Config:
     trace_dir: str | None = None  # obs: host span trace + heartbeat directory
     metrics_file: str | None = None  # obs: Prometheus text exposition file
     console_port: int | None = None  # obs: live HTTP console (0 = ephemeral)
+    # Incremental discovery (runtime/delta.py): --delta runs a change batch
+    # against a persisted base bundle; --delta-state makes a full run write
+    # one; --deletes names the delete batch files for a delta run.
+    delta_base: str | None = None
+    delta_state: str | None = None
+    delete_paths: list[str] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -128,9 +134,12 @@ def _load_prefix_trie(cfg: Config):
 
 
 def _resolve_inputs(cfg: Config):
-    """Input paths + quad-format sniff (shared by the native and Python paths)."""
-    paths = reader.resolve_path_patterns(cfg.input_paths, cfg.file_filter)
-    is_nq = paths[0].endswith((".nq", ".nq.gz"))
+    """Input paths + quad-format sniff (shared by the native and Python paths).
+    Empty inputs are legal only for delete-only delta runs (the CLI enforces
+    that), so the sniff just defaults to triples then."""
+    paths = (reader.resolve_path_patterns(cfg.input_paths, cfg.file_filter)
+             if cfg.input_paths else [])
+    is_nq = bool(paths) and paths[0].endswith((".nq", ".nq.gz"))
     return paths, is_nq
 
 
@@ -796,7 +805,19 @@ def _run(cfg: Config) -> RunResult:
         import json as _json
         print(_json.dumps(describe_plan(cfg), indent=2))
 
+    if cfg.delta_base:
+        # Incremental discovery: the change batch replays against the
+        # persisted base bundle host-side (runtime/delta.py); it emits
+        # through the same _emit_sinks/_report as a full run.
+        from . import delta
+        return delta.run_delta(cfg, phases, counters, stats)
+    tracer.set_status(mode="full")
+
     if cfg.sharded_ingest:
+        if cfg.delta_state:
+            print("note: --delta-state is not supported with "
+                  "--sharded-ingest yet; no delta bundle written",
+                  file=sys.stderr)
         return _run_sharded_ingest(cfg, phases, counters)
 
     # Native fused ingest (read+parse+intern in one C++ pass) whenever the
@@ -1021,6 +1042,11 @@ def _run(cfg: Config) -> RunResult:
                 progress.cleanup()  # per-pass snapshots are now superseded
             phases.run("checkpoint-discover", save_discover)
     counters["cind-counter"] = len(table)
+    if cfg.delta_state and _is_primary():
+        # Persist the base bundle (generation 0) the incremental runs load.
+        from . import delta
+        phases.run("delta-state", lambda: delta.write_base_bundle(
+            cfg, ids, dictionary, table, stats, phases.timings))
     counters.update({f"stat-{k}": v for k, v in stats.items()})
     _emit_sinks(cfg, phases, counters, table, dictionary, stats, ids)
 
@@ -1146,11 +1172,22 @@ def _emit_sinks(cfg: Config, phases: _Phases, counters: dict, table,
             def write_cert():
                 from ..obs import sentinel as obs_sentinel
                 paths, _ = _resolve_inputs(cfg)
+                if cfg.delete_paths:
+                    paths = list(paths) + reader.resolve_path_patterns(
+                        cfg.delete_paths)
+                extra = {"summary": summary, "n_cinds": len(table)}
+                delta_info = stats.get("delta") or {}
+                if delta_info.get("base_output_digest"):
+                    # Chain the incremental run onto its base: a verifier
+                    # walks base_output_digest links back to generation 0.
+                    extra["base_output_digest"] = \
+                        delta_info["base_output_digest"]
+                    extra["generation"] = delta_info.get("new_generation")
                 cert = integrity.run_certificate(
                     input_signature=checkpoint.input_signature(paths),
                     stages=stages, output_digest=stages["output"],
                     provenance=obs_sentinel.provenance(),
-                    extra={"summary": summary, "n_cinds": len(table)})
+                    extra=extra)
                 integrity.write_certificate(dest, cert)
             phases.run("write-certificate", write_cert)
 

@@ -235,3 +235,66 @@ def generate_dbpedia_shaped(n: int, seed: int = 0) -> np.ndarray:
     obj_ids = np.where(is_uri, obj_uri,
                        n_subj + n_pred + obj_lit)
     return np.stack([subj_ids, pred_ids, obj_ids.astype(np.int32)], axis=1)
+
+
+def triples_to_tokens(triples: np.ndarray) -> list[tuple[str, str, str]]:
+    """Integer id triples -> the `<v%09d>` URI tokens the .nt writers emit.
+
+    Zero-padded so lexicographic token order == numeric id order: the
+    canonical (sorted) dictionary a from-scratch run interns then ranks the
+    tokens exactly like the generator ranked the ids, which keeps planted
+    workloads easy to reason about in delta tests."""
+    return [tuple(f"<v{int(v):09d}>" for v in row)
+            for row in np.asarray(triples).reshape(-1, 3)]
+
+
+def write_nt(path, triples: np.ndarray) -> None:
+    """Serialize integer id triples as an .nt file (one line per row)."""
+    with open(path, "w") as f:
+        for s, p, o in triples_to_tokens(triples):
+            f.write(f"{s} {p} {o} .\n")
+
+
+def grow_delta_batches(triples: np.ndarray, frac: float, seed: int = 0):
+    """Grow an insert/delete script touching ~`frac` of the workload.
+
+    Returns (inserts, deletes): `deletes` are rows sampled from `triples`
+    (each retracts one line), `inserts` are half recombinations of existing
+    values (perturbing existing join lines) and half rows over brand-new
+    ids past the current maximum (minting new dictionary values — and with
+    them new buckets — in the delta run).  Row counts split the change
+    budget evenly; at least one of each when frac > 0."""
+    t = np.asarray(triples, np.int64)
+    n = t.shape[0]
+    rng = np.random.default_rng(seed)
+    n_changes = max(2, int(round(n * frac)))
+    n_del = max(1, n_changes // 2)
+    n_ins = max(1, n_changes - n_del)
+    deletes = t[rng.choice(n, size=min(n_del, n), replace=False)]
+    n_recomb = n_ins // 2
+    pool = np.unique(t.reshape(-1))
+    recomb = rng.choice(pool, size=(n_recomb, 3))
+    base = int(t.max()) + 1 if n else 0
+    fresh = base + np.arange((n_ins - n_recomb) * 3,
+                             dtype=np.int64).reshape(-1, 3)
+    inserts = np.concatenate([recomb.reshape(-1, 3), fresh])
+    return inserts.astype(np.int64), deletes.astype(np.int64)
+
+
+def apply_delta(triples: np.ndarray, inserts: np.ndarray,
+                deletes: np.ndarray) -> np.ndarray:
+    """The updated dataset a from-scratch comparator runs on: multiset
+    minus one occurrence per delete row, plus the insert rows (mirrors the
+    delta engine's bag semantics)."""
+    t = [tuple(r) for r in np.asarray(triples, np.int64).tolist()]
+    from collections import Counter
+    pending = Counter(map(tuple, np.asarray(deletes, np.int64).tolist()))
+    kept = []
+    for row in t:
+        if pending.get(row, 0) > 0:
+            pending[row] -= 1
+            continue
+        kept.append(row)
+    out = kept + [tuple(r) for r in np.asarray(inserts, np.int64).tolist()]
+    return (np.asarray(out, np.int64).reshape(-1, 3)
+            if out else np.zeros((0, 3), np.int64))

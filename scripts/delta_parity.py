@@ -1,0 +1,145 @@
+"""Delta-discovery parity gate (incremental CIND maintenance).
+
+Planted-CIND workload grown with an insert+delete batch on the CPU proxy;
+four checks:
+
+  1. bit-identity — a base run that persists a bundle (--delta-state), then
+     a --delta replay of a ~1% batch, must write byte-identical output to a
+     from-scratch run on the updated dataset (strategies 0 and 1: one
+     no-filter raw path, one filtered raw path);
+  2. incrementality — the delta run takes the incremental path and re-runs
+     only a strict subset of the pass partition (passes_reused > 0);
+  3. certificate chaining — the delta run's certificate carries
+     base_output_digest == the base run's certificate output_digest and the
+     advanced generation;
+  4. digest plumbing — the bundle written by the delta run reloads with
+     zero degradations (every stage digest verifies).
+
+scripts/verify.sh runs this before the bench gate; VERIFY_SKIP_DELTA=1
+opts out.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["RDFIND_BACKOFF_BASE_MS"] = "1"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    from rdfind_tpu.programs import rdfind
+    from rdfind_tpu.runtime import delta, driver
+    from rdfind_tpu.utils import synth
+
+    failures = []
+    support = 3
+    triples = synth.generate_triples(900, seed=3)
+    ins, dels = synth.grow_delta_batches(triples, 0.01, seed=4)
+
+    with tempfile.TemporaryDirectory() as root:
+        paths = {k: os.path.join(root, f"{k}.nt")
+                 for k in ("base", "ins", "del", "upd")}
+        synth.write_nt(paths["base"], triples)
+        synth.write_nt(paths["ins"], ins)
+        synth.write_nt(paths["del"], dels)
+        synth.write_nt(paths["upd"], synth.apply_delta(triples, ins, dels))
+        cert_base = os.path.join(root, "cert_base.json")
+        cert_delta = os.path.join(root, "cert_delta.json")
+        os.environ["RDFIND_INTEGRITY"] = "1"
+
+        for strat in ("0", "1"):
+            bundle = os.path.join(root, f"bundle{strat}")
+            o_delta = os.path.join(root, "out_delta.txt")
+            o_scratch = os.path.join(root, "out_scratch.txt")
+            common = ["--support", str(support),
+                      "--traversal-strategy", strat]
+
+            os.environ["RDFIND_CERT"] = cert_base
+            if rdfind.main([paths["base"], *common,
+                            "--delta-state", bundle]) != 0:
+                failures.append(f"strategy {strat}: base run failed")
+                continue
+            os.environ["RDFIND_CERT"] = cert_delta
+            if rdfind.main([paths["ins"], "--delta", bundle,
+                            "--deletes", paths["del"], *common,
+                            "--output", o_delta]) != 0:
+                failures.append(f"strategy {strat}: delta run failed")
+                continue
+            os.environ.pop("RDFIND_CERT", None)
+            if rdfind.main([paths["upd"], *common,
+                            "--output", o_scratch]) != 0:
+                failures.append(f"strategy {strat}: scratch run failed")
+                continue
+
+            got, want = open(o_delta).read(), open(o_scratch).read()
+            if got != want:
+                diff = sorted(set(got.splitlines())
+                              ^ set(want.splitlines()))
+                failures.append(
+                    f"strategy {strat}: delta output is not bit-identical "
+                    f"({len(diff)} differing rows, e.g. {diff[:3]})")
+            if not want.strip():
+                failures.append(
+                    f"strategy {strat}: empty output (gate is vacuous)")
+
+            cb = json.load(open(cert_base))
+            cd = json.load(open(cert_delta))
+            if cd.get("base_output_digest") != cb.get("output_digest"):
+                failures.append(
+                    f"strategy {strat}: certificate chain broken "
+                    f"({cd.get('base_output_digest')} != base "
+                    f"{cb.get('output_digest')})")
+            if cd.get("generation") != 1:
+                failures.append(f"strategy {strat}: delta certificate "
+                                f"generation {cd.get('generation')} != 1")
+
+            # Reload the advanced bundle: every stage digest must verify.
+            b = delta.load_bundle(bundle, min_support=support,
+                                  projections="spo", distinct=False)
+            if b.degraded:
+                failures.append(f"strategy {strat}: advanced bundle "
+                                f"degraded on reload: {b.degraded}")
+            if int(b.meta["generation"]) != 1:
+                failures.append(f"strategy {strat}: bundle generation "
+                                f"{b.meta['generation']} != 1")
+
+        # Incrementality: pass reuse visible in the stats fan-out.
+        bundle = os.path.join(root, "bundle0")
+        res = driver.run(driver.Config(
+            input_paths=[paths["ins"]], delete_paths=[paths["del"]],
+            min_support=support, traversal_strategy=0, delta_base=bundle))
+        st = res.counters.get("stat-delta", {})
+        if st.get("path") != "incremental":
+            failures.append(f"delta took path {st.get('path')!r}, "
+                            "expected 'incremental'")
+        if not (0 < st.get("passes_rerun", 0) < st.get("n_passes", 0)):
+            failures.append(
+                f"no pass reuse: reran {st.get('passes_rerun')} of "
+                f"{st.get('n_passes')} passes")
+        os.environ.pop("RDFIND_INTEGRITY", None)
+
+    if failures:
+        for f in failures:
+            print(f"delta_parity: {f}", file=sys.stderr)
+        return 1
+    print(f"delta_parity: OK — 1% batch bit-identical via --delta "
+          f"(strategies 0+1), certificate chained gen 0 -> 1, "
+          f"{st['passes_rerun']}/{st['n_passes']} passes re-run "
+          f"({st['passes_reused']} reused), advanced bundle "
+          "digest-verified")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

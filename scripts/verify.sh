@@ -102,6 +102,17 @@ elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/integrity_parity.py; t
     exit 1
 fi
 
+echo "== delta parity (base -> planted insert+delete batch -> --delta) =="
+# Incremental discovery must be bit-identical to from-scratch on the updated
+# dataset, chain its certificate onto the base run, and actually reuse
+# passes (proportional-to-change).  VERIFY_SKIP_DELTA=1 opts out.
+if [ "${VERIFY_SKIP_DELTA:-0}" = "1" ]; then
+    echo "verify: delta parity skipped (VERIFY_SKIP_DELTA=1)"
+elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/delta_parity.py; then
+    echo "verify: delta parity FAILED" >&2
+    exit 1
+fi
+
 if [ "${VERIFY_SKIP_BENCH:-0}" = "1" ]; then
     echo "verify: tier-1 green; bench + sentinel skipped (VERIFY_SKIP_BENCH=1)"
     exit 0
@@ -115,6 +126,22 @@ if ! BENCH_BACKEND=cpu JAX_PLATFORMS=cpu \
      BENCH_HISTORY="$hist" \
      timeout -k 10 1800 python bench.py > /tmp/_verify_bench.json; then
     echo "verify: tiny bench FAILED (see /tmp/_verify_bench.json)" >&2
+    exit 1
+fi
+if ! python -m rdfind_tpu.obs.sentinel --check --history "$hist"; then
+    exit 1
+fi
+
+echo "== tiny delta bench -> BENCH_HISTORY -> regression sentinel =="
+# Incremental-discovery speedup rows (delta_speedup_*, frac_passes_rerun):
+# the proportional-to-change claim, regression-gated like every other
+# metric.  Appends to the SAME history file; the rows carry a distinct
+# workload stamp so output digests never cross-compare with bench.py's.
+if ! BENCH_BACKEND=cpu JAX_PLATFORMS=cpu \
+     BENCH_DELTA_TRIPLES="${VERIFY_BENCH_DELTA_TRIPLES:-1200}" \
+     BENCH_HISTORY="$hist" \
+     timeout -k 10 1800 python bench_delta.py > /tmp/_verify_bench_delta.json; then
+    echo "verify: tiny delta bench FAILED (see /tmp/_verify_bench_delta.json)" >&2
     exit 1
 fi
 python -m rdfind_tpu.obs.sentinel --check --history "$hist"

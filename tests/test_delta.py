@@ -1,0 +1,456 @@
+"""Incremental (delta) discovery: runtime/delta.py + the --delta CLI path.
+
+The contract under test is bit-identity: a change batch replayed through
+``rdfind --delta BASE_DIR`` must produce byte-identical output to a
+from-scratch run on the updated dataset — for all four traversal strategies
+and the clean/distinct knobs, across chained generations.  Edge cases: a
+delete-only batch that kills CINDs, inserts minting brand-new dictionary
+values (new buckets), a batch dirtying enough evidence to trip the
+full-fallback ladder (named, still correct), corrupted bundles (meta/ingest
+corruption is a clean miss — CLI rc 66 — while evidence/cinds corruption is
+a named degradation with a correct answer), certificate chaining onto the
+base run, the stats["delta"] fan-out, and the CLI validation surface.
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from rdfind_tpu.obs import integrity
+from rdfind_tpu.programs import rdfind
+from rdfind_tpu.runtime import delta, driver
+from rdfind_tpu.utils import synth
+
+SUPPORT = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in ("RDFIND_DELTA_BUCKETS", "RDFIND_DELTA_PASSES",
+              "RDFIND_DELTA_VERIFY", "RDFIND_DELTA_FULL_FRAC",
+              "RDFIND_INTEGRITY", "RDFIND_CERT"):
+        monkeypatch.delenv(k, raising=False)
+    yield
+
+
+@pytest.fixture(scope="module")
+def workload(tmp_path_factory):
+    """Base dataset + a ~1% insert/delete batch + the updated dataset, all
+    as .nt files (shared by every bit-identity test in the module)."""
+    d = tmp_path_factory.mktemp("delta_wl")
+    triples = synth.generate_triples(500, seed=3)
+    ins, dels = synth.grow_delta_batches(triples, 0.01, seed=4)
+    paths = {k: str(d / f"{k}.nt") for k in ("base", "ins", "del", "upd")}
+    synth.write_nt(paths["base"], triples)
+    synth.write_nt(paths["ins"], ins)
+    synth.write_nt(paths["del"], dels)
+    synth.write_nt(paths["upd"], synth.apply_delta(triples, ins, dels))
+    return {"triples": triples, "ins": ins, "dels": dels, "paths": paths,
+            "dir": d}
+
+
+def _run(args, rc_want=0):
+    rc = rdfind.main([str(a) for a in args])
+    assert rc == rc_want, (rc, args)
+
+
+def _make_bundle(workload, bundle_dir, extra=()):
+    """One full run that persists a base bundle (strategy 0 reuses its own
+    table as the definitional set — the cheap path)."""
+    _run([workload["paths"]["base"], "--support", SUPPORT,
+          "--traversal-strategy", "0", *extra, "--delta-state", bundle_dir])
+
+
+@pytest.fixture(scope="module")
+def base_bundle(workload):
+    """A pristine generation-0 bundle; tests copytree it so each mutation
+    (a delta run advances the generation in place) starts from the same
+    base."""
+    b = str(workload["dir"] / "bundle0")
+    _make_bundle(workload, b)
+    return b
+
+
+def _fresh(base_bundle, tmp_path, name="bundle"):
+    dst = str(tmp_path / name)
+    shutil.copytree(base_bundle, dst)
+    return dst
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: delta output == from-scratch output on the updated dataset.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("strategy", ["0", "1", "2", "3"])
+def test_delta_bit_identical_per_strategy(workload, base_bundle, tmp_path,
+                                          strategy):
+    """The acceptance bar: a ~1% batch through --delta is byte-identical to
+    a from-scratch run, for every traversal strategy (the bundle itself is
+    strategy-agnostic — it stores the definitional full set; the delta run
+    re-applies the strategy's raw-output filter on emission)."""
+    p = workload["paths"]
+    bundle = _fresh(base_bundle, tmp_path)
+    o_delta, o_scratch = str(tmp_path / "d.txt"), str(tmp_path / "s.txt")
+    common = ["--support", SUPPORT, "--traversal-strategy", strategy]
+    _run([p["ins"], "--delta", bundle, "--deletes", p["del"], *common,
+          "--output", o_delta])
+    _run([p["upd"], *common, "--output", o_scratch])
+    assert open(o_delta).read() == open(o_scratch).read()
+
+
+@pytest.mark.parametrize("extra", [["--clean-implied"],
+                                   ["--use-fis"],
+                                   ["--distinct-triples"]])
+def test_delta_bit_identical_knobs(workload, base_bundle, tmp_path, extra):
+    """clean_implied reruns minimality over the merged set; use_fis is
+    output-neutral; distinct is a bundle meta knob (set semantics for the
+    batch too) — all three must stay bit-identical."""
+    p = workload["paths"]
+    if "--distinct-triples" in extra:
+        # distinct is pinned in the bundle meta: needs its own base.
+        bundle = str(tmp_path / "bundle")
+        _make_bundle(workload, bundle, extra=extra)
+    else:
+        bundle = _fresh(base_bundle, tmp_path)
+    o_delta, o_scratch = str(tmp_path / "d.txt"), str(tmp_path / "s.txt")
+    common = ["--support", SUPPORT, "--traversal-strategy", "1", *extra]
+    _run([p["ins"], "--delta", bundle, "--deletes", p["del"], *common,
+          "--output", o_delta])
+    _run([p["upd"], *common, "--output", o_scratch])
+    assert open(o_delta).read() == open(o_scratch).read()
+
+
+def test_delta_chained_generations(workload, base_bundle, tmp_path):
+    """Generation 1 -> generation 2: the bundle written by a delta run is
+    itself a valid base for the next batch, and stays bit-identical."""
+    p = workload["paths"]
+    bundle = _fresh(base_bundle, tmp_path)
+    common = ["--support", SUPPORT, "--traversal-strategy", "1"]
+    _run([p["ins"], "--delta", bundle, "--deletes", p["del"], *common])
+    upd1 = synth.apply_delta(workload["triples"], workload["ins"],
+                             workload["dels"])
+    ins2, dels2 = synth.grow_delta_batches(upd1, 0.02, seed=9)
+    p_i2, p_d2, p_u2 = (str(tmp_path / k) for k in
+                        ("i2.nt", "d2.nt", "u2.nt"))
+    synth.write_nt(p_i2, ins2)
+    synth.write_nt(p_d2, dels2)
+    synth.write_nt(p_u2, synth.apply_delta(upd1, ins2, dels2))
+    o_delta, o_scratch = str(tmp_path / "d.txt"), str(tmp_path / "s.txt")
+    _run([p_i2, "--delta", bundle, "--deletes", p_d2, *common,
+          "--output", o_delta])
+    _run([p_u2, *common, "--output", o_scratch])
+    assert open(o_delta).read() == open(o_scratch).read()
+    meta = json.loads(np.load(os.path.join(bundle, "delta-meta.npz"))
+                      ["meta_json"].tobytes().decode())
+    assert meta["generation"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Edge cases: delete-only kills, new-value inserts, full-fallback ladder.
+# ---------------------------------------------------------------------------
+
+
+def test_delete_only_batch_kills_cinds(workload, base_bundle, tmp_path):
+    """A delete-only batch (no insert files at all on the CLI) that drops
+    every triple of the most frequent predicate: the CINDs conditioned on
+    it lose their support and must vanish — and the survivors must match a
+    from-scratch run exactly."""
+    triples = workload["triples"]
+    preds, counts = np.unique(triples[:, 1], return_counts=True)
+    victim = preds[np.argmax(counts)]
+    dels = triples[triples[:, 1] == victim]
+    p_del, p_upd = str(tmp_path / "del.nt"), str(tmp_path / "upd.nt")
+    synth.write_nt(p_del, dels)
+    synth.write_nt(p_upd, synth.apply_delta(
+        triples, np.zeros((0, 3), np.int64), dels))
+    bundle = _fresh(base_bundle, tmp_path)
+    common = ["--support", SUPPORT, "--traversal-strategy", "0"]
+    o_base = str(tmp_path / "b.txt")
+    _run([workload["paths"]["base"], *common, "--output", o_base])
+    o_delta, o_scratch = str(tmp_path / "d.txt"), str(tmp_path / "s.txt")
+    _run(["--delta", bundle, "--deletes", p_del, *common,
+          "--output", o_delta])
+    _run([p_upd, *common, "--output", o_scratch])
+    assert open(o_delta).read() == open(o_scratch).read()
+    killed = set(open(o_base)) - set(open(o_delta))
+    assert killed, "deleting a whole predicate must kill some CINDs"
+
+
+def test_inserts_mint_new_values_and_buckets(workload, base_bundle,
+                                             tmp_path):
+    """Inserts whose tokens the base dictionary has never seen append to
+    the internal-id tail (counted as delta-new-values) and land in buckets
+    with no prior rows — and the output still matches from-scratch (the
+    canonical-id remap is where new values earn their sorted rank)."""
+    triples = workload["triples"]
+    top = int(triples.max())
+    ins = np.array([[top + 10, top + 11, top + 12],
+                    [top + 10, top + 11, top + 13],
+                    [top + 10, top + 11, top + 14]], np.int64)
+    p_ins, p_upd = str(tmp_path / "ins.nt"), str(tmp_path / "upd.nt")
+    synth.write_nt(p_ins, ins)
+    synth.write_nt(p_upd, synth.apply_delta(
+        triples, ins, np.zeros((0, 3), np.int64)))
+    bundle = _fresh(base_bundle, tmp_path)
+    res = driver.run(driver.Config(
+        input_paths=[p_ins], min_support=SUPPORT, traversal_strategy=0,
+        delta_base=bundle, collect_result=False))
+    assert res.counters["delta-new-values"] == 5  # 5 distinct new tokens
+    st = res.counters["stat-delta"]
+    assert st["path"] == "incremental"
+    assert st["new_values"] == 5
+    scratch = driver.run(driver.Config(
+        input_paths=[p_upd], min_support=SUPPORT, traversal_strategy=0))
+    assert integrity.digest_table(res.table) == \
+        integrity.digest_table(scratch.table)
+
+
+def test_large_batch_degrades_to_full_fallback(workload, base_bundle,
+                                               tmp_path):
+    """A batch dirtying more than RDFIND_DELTA_FULL_FRAC of the evidence
+    rows must take the named full-fallback path — a full re-run over the
+    updated bundle, never an incremental answer built on mostly-dirty
+    state — and still be bit-identical."""
+    triples = workload["triples"]
+    ins, dels = synth.grow_delta_batches(triples, 0.5, seed=11)
+    p_ins, p_del, p_upd = (str(tmp_path / k) for k in
+                           ("i.nt", "d.nt", "u.nt"))
+    synth.write_nt(p_ins, ins)
+    synth.write_nt(p_del, dels)
+    synth.write_nt(p_upd, synth.apply_delta(triples, ins, dels))
+    bundle = _fresh(base_bundle, tmp_path)
+    res = driver.run(driver.Config(
+        input_paths=[p_ins], delete_paths=[p_del], min_support=SUPPORT,
+        traversal_strategy=1, delta_base=bundle))
+    st = res.counters["stat-delta"]
+    assert st["path"] == "full-fallback"
+    assert st["passes_reused"] == 0
+    reasons = res.counters["stat-delta_degradations"]
+    assert any(r.startswith("dirty-frac-") for r in reasons), reasons
+    scratch = driver.run(driver.Config(
+        input_paths=[p_upd], min_support=SUPPORT, traversal_strategy=1))
+    assert integrity.digest_table(res.table) == \
+        integrity.digest_table(scratch.table)
+    # The fallback still advances the bundle: the next (small) batch runs
+    # incrementally against it.
+    meta = json.loads(np.load(os.path.join(bundle, "delta-meta.npz"))
+                      ["meta_json"].tobytes().decode())
+    assert meta["generation"] == 1
+
+
+def test_stats_delta_fanout(workload, base_bundle, tmp_path):
+    """The observability contract: stats["delta"] carries the run mode,
+    generation chain, dirtiness accounting, and pass reuse."""
+    p = workload["paths"]
+    bundle = _fresh(base_bundle, tmp_path)
+    res = driver.run(driver.Config(
+        input_paths=[p["ins"]], delete_paths=[p["del"]],
+        min_support=SUPPORT, traversal_strategy=0, delta_base=bundle))
+    st = res.counters["stat-delta"]
+    assert st["mode"] == "delta"
+    assert st["generation"] == 0 and st["new_generation"] == 1
+    assert st["path"] == "incremental"
+    assert st["inserts"] == len(workload["ins"])
+    assert st["deletes"] == len(workload["dels"])
+    assert st["dirty_lines"] > 0 and st["affected_captures"] > 0
+    assert 0 < st["dirty_row_frac"] <= 1
+    assert st["passes_rerun"] >= 1
+    assert st["passes_rerun"] + st["passes_reused"] == st["n_passes"]
+    # The whole point: a ~1% batch re-runs only a sliver of the passes.
+    assert st["passes_rerun"] < st["n_passes"] / 2
+    assert st["base_output_digest"]
+    assert isinstance(st["families"], dict) and st["families"]
+
+
+# ---------------------------------------------------------------------------
+# Corruption ladder: clean miss (rc 66) vs named degradation + right answer.
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_meta_is_clean_miss_rc66(workload, base_bundle, tmp_path,
+                                         capsys):
+    p = workload["paths"]
+    common = ["--support", SUPPORT, "--traversal-strategy", "0"]
+    bundle = _fresh(base_bundle, tmp_path)
+    with open(os.path.join(bundle, "delta-meta.npz"), "wb") as f:
+        f.write(b"not an npz")
+    _run([p["ins"], "--delta", bundle, "--deletes", p["del"], *common],
+         rc_want=66)
+    assert "delta base unusable" in capsys.readouterr().err
+
+
+def test_missing_ingest_stage_is_clean_miss_rc66(workload, base_bundle,
+                                                 tmp_path):
+    p = workload["paths"]
+    bundle = _fresh(base_bundle, tmp_path)
+    os.unlink(os.path.join(bundle, "delta-ingest.npz"))
+    _run([p["ins"], "--delta", bundle, "--deletes", p["del"],
+          "--support", SUPPORT], rc_want=66)
+
+
+def test_knob_mismatch_is_clean_miss_rc66(workload, base_bundle, tmp_path):
+    """A bundle built at support 3 cannot answer a support-4 delta run."""
+    p = workload["paths"]
+    bundle = _fresh(base_bundle, tmp_path)
+    _run([p["ins"], "--delta", bundle, "--deletes", p["del"],
+          "--support", SUPPORT + 1], rc_want=66)
+
+
+def test_missing_evidence_stage_rebuilds_named(workload, base_bundle,
+                                               tmp_path, capsys):
+    """Evidence is a pure function of the bundled triples: losing the stage
+    is a named degradation (host rebuild), never a wrong answer."""
+    p = workload["paths"]
+    bundle = _fresh(base_bundle, tmp_path)
+    os.unlink(os.path.join(bundle, "delta-evidence.npz"))
+    common = ["--support", SUPPORT, "--traversal-strategy", "0"]
+    o_delta, o_scratch = str(tmp_path / "d.txt"), str(tmp_path / "s.txt")
+    _run([p["ins"], "--delta", bundle, "--deletes", p["del"], *common,
+          "--output", o_delta])
+    assert "delta base degraded: evidence-stage-missing" in \
+        capsys.readouterr().err
+    _run([p["upd"], *common, "--output", o_scratch])
+    assert open(o_delta).read() == open(o_scratch).read()
+
+
+def test_missing_cinds_stage_full_fallback_named(workload, base_bundle,
+                                                 tmp_path, capsys):
+    """The definitional set has no incremental rebuild without its prior
+    value: a lost cinds stage forces the (named) full path, still exact."""
+    p = workload["paths"]
+    bundle = _fresh(base_bundle, tmp_path)
+    os.unlink(os.path.join(bundle, "delta-cinds.npz"))
+    common = ["--support", SUPPORT, "--traversal-strategy", "0"]
+    o_delta, o_scratch = str(tmp_path / "d.txt"), str(tmp_path / "s.txt")
+    _run([p["ins"], "--delta", bundle, "--deletes", p["del"], *common,
+          "--output", o_delta])
+    assert "delta base degraded: cinds-stage-missing" in \
+        capsys.readouterr().err
+    _run([p["upd"], *common, "--output", o_scratch])
+    assert open(o_delta).read() == open(o_scratch).read()
+
+
+def _tamper(bundle, stage, key, flip):
+    """Rewrite one stage npz with `key` modified but the fingerprint intact
+    — a silent bit flip the CheckpointStore cannot see."""
+    path = os.path.join(bundle, f"{stage}.npz")
+    z = dict(np.load(path))
+    z[key] = flip(z[key])
+    np.savez(path, **z)
+
+
+def test_tampered_evidence_detected_by_pass_digests(workload, base_bundle,
+                                                    tmp_path):
+    """A silent flip inside the evidence rows (fingerprint intact) must be
+    caught by the per-pass digest lanes and degraded to a rebuild."""
+    bundle = _fresh(base_bundle, tmp_path)
+
+    def flip(rows):
+        rows = rows.copy()
+        rows[0, 1] ^= 1
+        return rows
+    _tamper(bundle, "delta-evidence", "rows", flip)
+    b = delta.load_bundle(bundle, min_support=SUPPORT, projections="spo",
+                          distinct=False)
+    assert "evidence-digest-mismatch" in b.degraded
+    assert b.rows is None  # forces the exact host rebuild downstream
+
+
+def test_tampered_ingest_is_untrustable(workload, base_bundle, tmp_path):
+    """A flip in the triple table itself poisons everything derived from
+    it: DeltaBaseError, not a degradation."""
+    bundle = _fresh(base_bundle, tmp_path)
+
+    def flip(ids):
+        ids = ids.copy()
+        ids[0, 0] += 1
+        return ids
+    _tamper(bundle, "delta-ingest", "ids", flip)
+    with pytest.raises(delta.DeltaBaseError, match="digest mismatch"):
+        delta.load_bundle(bundle, min_support=SUPPORT, projections="spo",
+                          distinct=False)
+
+
+def test_verify_opt_out(workload, base_bundle, tmp_path, monkeypatch):
+    """RDFIND_DELTA_VERIFY=0 skips load-time digest checks (trusted local
+    disk); the tampered bundle then loads without complaint."""
+    bundle = _fresh(base_bundle, tmp_path)
+
+    def flip(ids):
+        ids = ids.copy()
+        ids[0, 0] += 1
+        return ids
+    _tamper(bundle, "delta-ingest", "ids", flip)
+    monkeypatch.setenv("RDFIND_DELTA_VERIFY", "0")
+    b = delta.load_bundle(bundle, min_support=SUPPORT, projections="spo",
+                          distinct=False)
+    assert b.degraded == []
+
+
+# ---------------------------------------------------------------------------
+# Layout pinning + certificate chaining + CLI validation.
+# ---------------------------------------------------------------------------
+
+
+def test_layout_knobs_pinned_at_creation(workload, tmp_path, monkeypatch):
+    """RDFIND_DELTA_BUCKETS/PASSES are read once, when the base bundle is
+    written; a later delta run under different env must use the bundle's
+    own layout (digests would be garbage otherwise)."""
+    p = workload["paths"]
+    monkeypatch.setenv("RDFIND_DELTA_BUCKETS", "64")
+    monkeypatch.setenv("RDFIND_DELTA_PASSES", "16")
+    bundle = str(tmp_path / "bundle")
+    _make_bundle(workload, bundle)
+    monkeypatch.delenv("RDFIND_DELTA_BUCKETS")
+    monkeypatch.delenv("RDFIND_DELTA_PASSES")
+    common = ["--support", SUPPORT, "--traversal-strategy", "0"]
+    o_delta, o_scratch = str(tmp_path / "d.txt"), str(tmp_path / "s.txt")
+    _run([p["ins"], "--delta", bundle, "--deletes", p["del"], *common,
+          "--output", o_delta])
+    _run([p["upd"], *common, "--output", o_scratch])
+    assert open(o_delta).read() == open(o_scratch).read()
+    meta = json.loads(np.load(os.path.join(bundle, "delta-meta.npz"))
+                      ["meta_json"].tobytes().decode())
+    assert meta["num_buckets"] == 64 and meta["n_passes"] == 16
+
+
+def test_certificate_chains_onto_base(workload, tmp_path, monkeypatch):
+    """The delta run's certificate must link back to its base run:
+    base_output_digest == the base certificate's output_digest."""
+    p = workload["paths"]
+    bundle = str(tmp_path / "bundle")
+    cert_base = str(tmp_path / "cert_base.json")
+    cert_delta = str(tmp_path / "cert_delta.json")
+    monkeypatch.setenv("RDFIND_INTEGRITY", "1")
+    monkeypatch.setenv("RDFIND_CERT", cert_base)
+    _make_bundle(workload, bundle)
+    monkeypatch.setenv("RDFIND_CERT", cert_delta)
+    _run([p["ins"], "--delta", bundle, "--deletes", p["del"],
+          "--support", SUPPORT, "--traversal-strategy", "0"])
+    base = json.load(open(cert_base))
+    dlt = json.load(open(cert_delta))
+    assert dlt["base_output_digest"] == base["output_digest"]
+    assert dlt["generation"] == 1
+    assert "delta-evidence" in dlt["stages"]
+    assert dlt["output_digest"] != base["output_digest"]
+
+
+def test_cli_validation(workload, tmp_path):
+    p = workload["paths"]
+    with pytest.raises(SystemExit):  # --deletes requires --delta
+        rdfind.main([p["base"], "--deletes", p["del"]])
+    with pytest.raises(SystemExit):  # no inputs without a delete-only delta
+        rdfind.main(["--support", "3"])
+    with pytest.raises(SystemExit):  # ingest-shape flags clash with --delta
+        rdfind.main([p["ins"], "--delta", str(tmp_path / "b"),
+                     "--sharded-ingest"])
+    with pytest.raises(SystemExit):
+        rdfind.main([p["ins"], "--delta", str(tmp_path / "b"),
+                     "--checkpoint-dir", str(tmp_path / "ck")])
+    # A --delta run against a directory with no bundle: clean miss.
+    assert rdfind.main([p["ins"], "--delta", str(tmp_path / "nothere"),
+                        "--support", "3"]) == 66

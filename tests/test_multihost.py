@@ -162,8 +162,13 @@ def _dir_restorer(path):
 
     Handed to _run_procs as on_retry by the checkpointed tests: an
     infrastructure-flake retry must see the same on-disk state the failed
-    attempt started from, not whatever partial checkpoints it left behind."""
-    snap = ({p.name: p.read_bytes() for p in path.iterdir() if p.is_file()}
+    attempt started from, not whatever partial checkpoints it left behind.
+    The snapshot is RECURSIVE: checkpoint stores grow per-attempt scratch
+    subdirectories (tmp spill dirs, per-stage npz under nested layouts), and
+    a top-level-only snapshot silently leaked those into the retry — the
+    intermittent resume-assertion flips PR 13 noted."""
+    snap = ({str(p.relative_to(path)): p.read_bytes()
+             for p in sorted(path.rglob("*")) if p.is_file()}
             if path.exists() else None)
 
     def restore():
@@ -172,8 +177,37 @@ def _dir_restorer(path):
         if snap is not None:
             path.mkdir()
             for name, data in snap.items():
-                (path / name).write_bytes(data)
+                dest = path / name
+                dest.parent.mkdir(parents=True, exist_ok=True)
+                dest.write_bytes(data)
     return restore
+
+
+def test_dir_restorer_recursive(tmp_path):
+    """The retry restorer's contract: files a failed attempt created vanish
+    (including nested ones), files it modified or deleted come back byte
+    for byte, and a restorer for a not-yet-existing dir removes the dir."""
+    d = tmp_path / "ck"
+    (d / "sub").mkdir(parents=True)
+    (d / "stage.npz").write_bytes(b"base")
+    (d / "sub" / "part.npz").write_bytes(b"nested")
+    restore = _dir_restorer(d)
+    (d / "stage.npz").write_bytes(b"CLOBBERED")
+    (d / "sub" / "part.npz").unlink()
+    (d / "leak.npz").write_bytes(b"partial")
+    (d / "sub2").mkdir()
+    (d / "sub2" / "leak2.npz").write_bytes(b"partial")
+    restore()
+    assert (d / "stage.npz").read_bytes() == b"base"
+    assert (d / "sub" / "part.npz").read_bytes() == b"nested"
+    assert not (d / "leak.npz").exists()
+    assert not (d / "sub2").exists()
+    absent = tmp_path / "never"
+    restore_absent = _dir_restorer(absent)
+    absent.mkdir()
+    (absent / "x").write_bytes(b"y")
+    restore_absent()
+    assert not absent.exists()
 
 
 def _run_ingest_workers(paths, mode: str, strategy: str = "0"):

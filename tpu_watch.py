@@ -253,6 +253,14 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
         where = b.get("stage")
         if b.get("pass") is not None:
             where = f"{where} pass {b.get('pass')}"
+        # Run mode rides the heartbeat status (runtime/delta.py sets
+        # mode=delta + the base generation; the driver sets mode=full), so
+        # an operator can tell an incremental replay from a full rebuild
+        # without reading the run's stats.
+        if b.get("mode") == "delta":
+            where = f"{where} [delta, base gen {b.get('generation')}]"
+        elif b.get("mode"):
+            where = f"{where} [{b.get('mode')}]"
         flags = (" (final)" if b.get("final") else
                  " (STALE)" if b["stale"] else "")
         print(f"status[{obs_dir}] host {h}: last event {b['age_s']}s ago "
