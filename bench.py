@@ -950,6 +950,26 @@ def _run(n: int, min_support: int) -> dict:
     except Exception as e:
         detail["obs"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # Collective-watchdog cost row: the disabled guard is on every dispatch
+    # of every run, so its per-hit cost is a standing tax — sample it here
+    # (micro-loop over the real guard path) next to the counters the
+    # headline run accumulated, so the <2% overhead budget asserted in
+    # tests/test_watchdog.py stays visible in every BENCH_* artifact.
+    try:
+        from rdfind_tpu.runtime import watchdog
+        reps = 20000
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            with watchdog.collective("pairs", nbytes=1024):
+                pass
+        per_guard_us = (time.perf_counter() - t0) / reps * 1e6
+        detail["watchdog"] = {
+            "disabled_per_guard_us": round(per_guard_us, 3),
+            **watchdog.snapshot(),
+        }
+    except Exception as e:
+        detail["watchdog"] = {"error": f"{type(e).__name__}: {e}"}
+
     # Pallas packed-bitset kernel vs jnp planes path, on this backend.
     try:
         from rdfind_tpu.ops import sketch

@@ -92,7 +92,7 @@ from ..parallel.mesh import (AXIS, allgather_host_values,
                              host_gather, host_gather_many, make_global,
                              make_mesh, maybe_link_probe, shard_map,
                              topology_hosts)
-from ..runtime import dispatch, faults
+from ..runtime import dispatch, faults, watchdog
 
 SENTINEL = segments.SENTINEL
 
@@ -979,12 +979,27 @@ class _SkewMeter:
         self.totals = np.zeros(len(self.PHASES) + 1)
         self.n_committed = 0
 
+    def vec(self, phase_ms: dict) -> list:
+        """This host's [4 phases + wall] sample for the commit collective."""
+        v = [float(phase_ms.get(ph, 0.0)) for ph in self.PHASES]
+        v.append(sum(v))
+        return v
+
     def pass_committed(self, phase_ms: dict) -> None:
-        vec = [float(phase_ms.get(ph, 0.0)) for ph in self.PHASES]
-        vec.append(sum(vec))
+        """Standalone form (one allgather); the pass executor instead rides
+        the coalesced pass_commit collective via pass_committed_rows."""
+        vec = self.vec(phase_ms)
+        self.pass_committed_rows(
+            vec, allgather_host_values(vec, site="pass_commit"))
+
+    def pass_committed_rows(self, vec: list, m: np.ndarray) -> None:
+        """Consume this host's vec + the already-allgathered (hosts, 5)
+        matrix — the executor batches the skew sample onto the same
+        per-pass collective as the integrity digest agreement, so the two
+        consumers cost ONE allgather per committed pass (the gloo
+        many-tiny-collectives abort scales with collective count)."""
         self.totals += np.asarray(vec)
         self.n_committed += 1
-        m = allgather_host_values(vec)
         walls = m[:, -1]
         slowest = int(walls.argmax())
         skew = float(walls.max() / max(float(walls.mean()), 1e-9))
@@ -1071,6 +1086,9 @@ class _Pipeline:
         self.min_support = min_support
         self.max_retries = max_retries
         self.stats = stats
+        # The watchdog is process-global; point its fire path's degradation
+        # ledger at this run's stats dict.
+        watchdog.bind_stats(stats)
         self.skew = skew if skew is not None else DEFAULT_SKEW
         self.combine = combine
         # Hierarchical (two-level ICI/DCN) exchange configuration: None = flat
@@ -1164,20 +1182,23 @@ class _Pipeline:
                 hosts=self.hosts, hier=hier_on,
                 dcn_capacity=self.cap_a_dcn if hier_on else None))
             t0 = time.perf_counter() if self._timed else 0.0
-            out = _lines_step(
-                self._triples, self._n_valid, jnp.int32(min_support),
-                mesh=mesh, projections=projections, use_fis=use_fis,
-                use_ars=use_ars, cap_freq=self.cap_f, cap_exchange_a=self.cap_a,
-                skew=self.skew, combine=self.combine,
-                cap_freq_dcn=self.cap_f_dcn,
-                cap_exchange_a_dcn=self.cap_a_dcn, hier=self.hier,
-                dcn_chunks=self.dcn_chunks)
-            if self._timed:
-                jax.block_until_ready(out)
-                exchange.log_dispatch_timing(
-                    stats, pend, (time.perf_counter() - t0) * 1e3)
-            *line_cols, n_rows, plan, overflow = out
-            ovf = host_gather(overflow).reshape(self.num_dev, 2)[0]
+            with watchdog.collective(
+                    "freq", sum(e.get("bytes", 0) for e in pend)):
+                out = _lines_step(
+                    self._triples, self._n_valid, jnp.int32(min_support),
+                    mesh=mesh, projections=projections, use_fis=use_fis,
+                    use_ars=use_ars, cap_freq=self.cap_f,
+                    cap_exchange_a=self.cap_a,
+                    skew=self.skew, combine=self.combine,
+                    cap_freq_dcn=self.cap_f_dcn,
+                    cap_exchange_a_dcn=self.cap_a_dcn, hier=self.hier,
+                    dcn_chunks=self.dcn_chunks)
+                if self._timed:
+                    jax.block_until_ready(out)
+                    exchange.log_dispatch_timing(
+                        stats, pend, (time.perf_counter() - t0) * 1e3)
+                *line_cols, n_rows, plan, overflow = out
+                ovf = host_gather(overflow).reshape(self.num_dev, 2)[0]
             if faults.overflow_injected("overflow@lines"):
                 ovf = np.maximum(ovf, 1)
             if int(ovf.sum()) == 0:
@@ -1260,16 +1281,19 @@ class _Pipeline:
                 hosts=self.hosts, hier=hier_on,
                 dcn_capacity=self.cap_b_dcn if hier_on else None)]
             t0 = time.perf_counter() if self._timed else 0.0
-            out = _captures_step(*self.lines, self.n_rows, mesh=mesh,
-                                 cap_exchange_b=self.cap_b,
-                                 cap_exchange_b_dcn=self.cap_b_dcn,
-                                 hier=self.hier, dcn_chunks=self.dcn_chunks)
-            if self._timed:
-                jax.block_until_ready(out)
-                exchange.log_dispatch_timing(
-                    stats, pend, (time.perf_counter() - t0) * 1e3)
-            *tbl, n_caps, ovf_b = out
-            ovf_b = int(host_gather(ovf_b)[0])
+            with watchdog.collective(
+                    "captures", sum(e.get("bytes", 0) for e in pend)):
+                out = _captures_step(*self.lines, self.n_rows, mesh=mesh,
+                                     cap_exchange_b=self.cap_b,
+                                     cap_exchange_b_dcn=self.cap_b_dcn,
+                                     hier=self.hier,
+                                     dcn_chunks=self.dcn_chunks)
+                if self._timed:
+                    jax.block_until_ready(out)
+                    exchange.log_dispatch_timing(
+                        stats, pend, (time.perf_counter() - t0) * 1e3)
+                *tbl, n_caps, ovf_b = out
+                ovf_b = int(host_gather(ovf_b)[0])
             if faults.overflow_injected("overflow@captures"):
                 ovf_b = max(ovf_b, 1)
             if ovf_b == 0:
@@ -1441,20 +1465,32 @@ class _Pipeline:
                                 pass_idx=p)
         return blocks
 
-    def _check_replica_agreement(self, blocks, tele, p, what, block_layout):
-        """Multi-host digest agreement at the pass boundary: allgather this
-        host's RECOMPUTED block digest and compare rows.  A divergent
-        replica surfaces as a named IntegrityError on EVERY host — each
-        decides from identical allgathered state, so no host wedges a later
+    def _agreement_payload(self, blocks, p, block_layout) -> list:
+        """This host's [pass, digest_a, digest_b] rows for the pass-commit
+        collective (multi-host digest agreement, PR 15): the RECOMPUTED
+        block digest, compared across hosts after the batched allgather."""
+        a, b = self._host_digest(blocks, block_layout)
+        return [float(p), float(a), float(b)]
+
+    def _agreement_check(self, rows, p, what) -> None:
+        """Compare the allgathered digest rows.  A divergent replica
+        surfaces as a named IntegrityError on EVERY host — each decides
+        from identical allgathered state, so no host wedges a later
         collective against inconsistent peers.  Runs only when the
         integrity knob is on (the env must agree across hosts, same
         contract as RDFIND_TRACE)."""
-        a, b = self._host_digest(blocks, block_layout)
-        rows = allgather_host_values([float(p), float(a), float(b)])
         if bool((rows.max(axis=0) != rows.min(axis=0)).any()):
             raise integrity.IntegrityError(
                 f"{what}: replica digest divergence at pass {p}: "
                 f"{rows.tolist()}")
+
+    def _check_replica_agreement(self, blocks, tele, p, what, block_layout):
+        """Standalone form (one allgather); the pass executor instead rides
+        the coalesced pass_commit collective."""
+        rows = allgather_host_values(
+            self._agreement_payload(blocks, p, block_layout),
+            site="pass_commit")
+        self._agreement_check(rows, p, what)
 
     def _maybe_rebalance(self):
         """Greedy least-loaded reassignment of hot lines (the reference's
@@ -1521,17 +1557,19 @@ class _Pipeline:
                                           hosts=self.hosts,
                                           hier=self.hier is not None)]
             t0 = time.perf_counter() if self._timed else 0.0
-            out = _rebalance_step(*self.lines, self.n_rows,
-                                  moved_jv, moved_dest,
-                                  mesh=self.mesh, cap_move=cap_move,
-                                  hier=self.hier,
-                                  dcn_chunks=self.dcn_chunks)
-            if self._timed:
-                jax.block_until_ready(out)
-                exchange.log_dispatch_timing(
-                    self.stats, pend, (time.perf_counter() - t0) * 1e3)
-            *cols, n_rows, ovf = out
-            ovf = int(host_gather(ovf)[0])
+            with watchdog.collective(
+                    "rebalance", sum(e.get("bytes", 0) for e in pend)):
+                out = _rebalance_step(*self.lines, self.n_rows,
+                                      moved_jv, moved_dest,
+                                      mesh=self.mesh, cap_move=cap_move,
+                                      hier=self.hier,
+                                      dcn_chunks=self.dcn_chunks)
+                if self._timed:
+                    jax.block_until_ready(out)
+                    exchange.log_dispatch_timing(
+                        self.stats, pend, (time.perf_counter() - t0) * 1e3)
+                *cols, n_rows, ovf = out
+                ovf = int(host_gather(ovf)[0])
             if faults.overflow_injected("overflow@rebalance"):
                 ovf = max(ovf, 1)
             if ovf == 0:
@@ -1698,7 +1736,7 @@ class _Pipeline:
                 if 0 <= p < snap.n_pass:
                     w, bit = divmod(int(p), 32)
                     vote[2 + w] = float(int(vote[2 + w]) | (1 << bit))
-        votes = allgather_host_values(vote)
+        votes = allgather_host_values(vote, site="resume_vote")
         self._note_resume(vote_rounds=1)
         holders = votes[votes[:, 0] > 0]
         if holders.shape[0] == 0:
@@ -1992,11 +2030,18 @@ class _Pipeline:
                 d.saw_in_flight(len(inflight))
                 p, cols, n_out, tele = inflight.popleft()
                 t_counters = now()
-                tele_h = d.timed_pull(
-                    lambda: exchange.unpack_counters(host_gather(tele),
-                                                     _TELE_LANES,
-                                                     self.num_dev),
-                    overlapped=bool(inflight), what="pull-counters")
+                # The counters pull drains the head pass's whole device
+                # program (exchange C + giant gather included) — the
+                # deadman's payload estimate is the pass's exchange volume.
+                pass_nbytes = self.num_dev * (
+                    self.cap_c * _LANES_EXCHANGE_C + self.cap_g * _LANES_GIANT
+                ) * 4
+                with watchdog.collective("pairs", pass_nbytes):
+                    tele_h = d.timed_pull(
+                        lambda: exchange.unpack_counters(host_gather(tele),
+                                                         _TELE_LANES,
+                                                         self.num_dev),
+                        overlapped=bool(inflight), what="pull-counters")
                 ovf = tele_h[:_N_OVF]
                 if faults.overflow_injected(f"overflow@{site}", pass_idx=p):
                     ovf = np.maximum(np.asarray(ovf), 1)
@@ -2017,16 +2062,21 @@ class _Pipeline:
                     p_next = p  # resume from the failed pass only
                     continue
                 t_blocks = now()
-                parts[p] = d.timed_pull(
-                    lambda: self.collect_blocks(cols, n_out),
-                    overlapped=bool(inflight), what="pull-blocks")
+                with watchdog.collective("pairs", pass_nbytes):
+                    parts[p] = d.timed_pull(
+                        lambda: self.collect_blocks(cols, n_out),
+                        overlapped=bool(inflight), what="pull-blocks")
                 teles[p] = tuple(int(x) for x in tele_h[_N_OVF:])
+                agree_payload = None
                 if self._integrity_on:
                     parts[p] = self._verify_pull(parts[p], teles[p], p, what,
                                                  block_layout, cols, n_out)
-                    if jax.process_count() > 1:
-                        self._check_replica_agreement(parts[p], teles[p], p,
-                                                      what, block_layout)
+                    # Digest agreement rides the coalesced pass_commit
+                    # collective below (single-process the rows trivially
+                    # agree; multi-process this is the PR-15 check at zero
+                    # extra collectives).
+                    agree_payload = self._agreement_payload(parts[p], p,
+                                                            block_layout)
                 if self._datastats_on or fc is not None:
                     # Per-pass cap-utilization trajectory from the tail
                     # telemetry lanes (already pulled — zero extra host
@@ -2059,13 +2109,30 @@ class _Pipeline:
                         i: (parts[i], teles[i]) for i in range(self.n_pass)
                         if parts[i] is not None},
                         num_dev=self.num_dev, n_pass=self.n_pass)
-                if meter.active:
+                if meter.active or agree_payload is not None:
+                    # ONE batched per-pass collective carrying [pass,
+                    # digest_a, digest_b?] + [phase breakdown?]: digest
+                    # agreement and the skew meter used to cost one tiny
+                    # allgather EACH — the gloo many-tiny-collectives abort
+                    # scales with collective count, so they now share a
+                    # payload.  Runs after progress.submit: a pass whose
+                    # agreement later fails is digest-re-verified (clean
+                    # miss) when its snapshot loads on resume.
                     t_end = now()
-                    meter.pass_committed({
+                    vec = meter.vec({
                         "exchange": (t_counters - t_fill) * 1e3,
                         "compute": (t_blocks - t_counters) * 1e3,
                         "pull": (t_commit - t_blocks) * 1e3,
-                        "commit": (t_end - t_commit) * 1e3})
+                        "commit": (t_end - t_commit) * 1e3,
+                    }) if meter.active else []
+                    agree_head = agree_payload or []
+                    rows = allgather_host_values(agree_head + vec,
+                                                 site="pass_commit")
+                    if agree_payload is not None:
+                        self._agreement_check(rows[:, :3], p, what)
+                    if meter.active:
+                        meter.pass_committed_rows(
+                            vec, rows[:, len(agree_head):])
                 if faults.fires("preempt@discover", pass_idx=p):
                     if progress is not None:
                         progress.flush()  # the SIGTERM handler's analog
@@ -2075,6 +2142,7 @@ class _Pipeline:
                   for i in range(len(parts[0]))]
         if self.stats is not None:
             d.publish(self.stats)
+            watchdog.publish(self.stats)
             metrics.gauge_set(self.stats, "cap_p_final", self.cap_p)
             # The overlap-efficiency row of this attempt (the DCN-chunk
             # autotuner input) and the cross-host skew verdict.
@@ -2158,14 +2226,18 @@ class _Pipeline:
             self.stats, num_dev=self.num_dev, bits=self.ha_bits,
             hosts=self.hosts, hier=hier_on)]
         t0 = time.perf_counter() if self._timed else 0.0
-        out = _ha_reduce_step(make_global(stacked, self.mesh),
-                              mesh=self.mesh, bits=self.ha_bits,
-                              cap=sketch.MAX_COUNT_MIN_CAP, hier=self.hier)
-        if self._timed:
-            jax.block_until_ready(out)
-            exchange.log_dispatch_timing(self.stats, pend,
-                                         (time.perf_counter() - t0) * 1e3)
-        table = np.asarray(host_gather(out)).reshape(-1, self.ha_bits)[0]
+        with watchdog.collective(
+                "sketch", sum(e.get("bytes", 0) for e in pend)):
+            out = _ha_reduce_step(make_global(stacked, self.mesh),
+                                  mesh=self.mesh, bits=self.ha_bits,
+                                  cap=sketch.MAX_COUNT_MIN_CAP,
+                                  hier=self.hier)
+            if self._timed:
+                jax.block_until_ready(out)
+                exchange.log_dispatch_timing(
+                    self.stats, pend, (time.perf_counter() - t0) * 1e3)
+            table = np.asarray(host_gather(out)).reshape(-1,
+                                                         self.ha_bits)[0]
         if self.stats is not None:
             metrics.counter_add(self.stats, "ha_build_rounds")
             metrics.counter_add(self.stats, "total_pairs", sum(npt))

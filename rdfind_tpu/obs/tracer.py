@@ -275,3 +275,11 @@ def set_status(**kv) -> None:
     off: the heartbeat file only exists under an armed tracer."""
     if _TRACER is not None:
         _TRACER._status.update(kv)
+
+
+def heartbeat_now() -> None:
+    """Force an immediate (unthrottled) heartbeat write carrying the
+    current status — the watchdog's fire path must land its wedged/
+    recovering stamp before the process potentially exits."""
+    if _TRACER is not None:
+        _TRACER._beat.beat(_TRACER._status)

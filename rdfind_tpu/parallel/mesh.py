@@ -268,14 +268,96 @@ def initialize_multihost(coordinator: str, num_processes: int,
         pass  # versions without the flag don't need it
     kwargs = dict(coordinator_address=coordinator,
                   num_processes=num_processes, process_id=process_id)
-    try:
-        jax.distributed.initialize(
-            **kwargs, shutdown_timeout_seconds=shutdown_timeout_seconds)
-    except TypeError:
-        # Older jax predates the knob (its exit barrier is not configurable);
-        # joining with the default barrier beats not joining at all.
-        jax.distributed.initialize(**kwargs)
+    extra = dict(shutdown_timeout_seconds=shutdown_timeout_seconds,
+                 **_init_timeout_kwargs())
+    while True:
+        try:
+            jax.distributed.initialize(**kwargs, **extra)
+            break
+        except TypeError:
+            # Older jax predates one of the knobs (exit barrier /
+            # per-attempt init timeout): drop them one at a time — joining
+            # with defaults beats not joining at all.
+            if not extra:
+                raise
+            extra.popitem()
     _MULTIHOST_INITIALIZED = True
+
+
+def ensure_distributed(coordinator: str, num_processes: int,
+                       process_id: int, *,
+                       shutdown_timeout_seconds: int = 7200) -> int:
+    """`initialize_multihost` with bounded retry + seeded exponential
+    backoff around the rendezvous — the product-level form of the retry
+    the two-process test tier used to carry in-test.
+
+    The gloo TCP rendezvous wedges nondeterministically on loaded CI boxes
+    (observed ~9-minute burns before an external retry rescued the run);
+    here each attempt is bounded by the distributed runtime's own
+    initialization timeout (RDFIND_INIT_TIMEOUT_S where the jax version
+    accepts it) under a watchdog deadman, failures back off with the fault
+    ladder's jittered schedule, and RDFIND_INIT_RETRIES (default 3)
+    attempts are made before giving up.  Returns the number of retries
+    used (0 = first attempt joined), published as
+    ``distributed_init_retries`` in the metrics registry.
+
+    Single-process callers (num_processes <= 1) are a no-op returning 0.
+    """
+    from ..obs import metrics
+    from ..runtime import faults, watchdog
+
+    if num_processes <= 1:
+        return 0
+    tries = max(1, int(os.environ.get("RDFIND_INIT_RETRIES", "3")))
+    last: Exception | None = None
+    for attempt in range(tries):
+        try:
+            with watchdog.collective("init", force=True):
+                initialize_multihost(
+                    coordinator, num_processes, process_id,
+                    shutdown_timeout_seconds=shutdown_timeout_seconds)
+            metrics.gauge_set(None, "distributed_init_retries", attempt)
+            return attempt
+        except (faults.Preempted, faults.FallbackRequired):
+            raise
+        except Exception as e:
+            last = e
+            _teardown_distributed()
+            if attempt == tries - 1:
+                break
+            delay_ms = faults._backoff_ms(attempt)
+            print(f"rdfind: distributed init attempt {attempt + 1}/{tries} "
+                  f"failed ({e}); retrying after {delay_ms:.0f} ms",
+                  file=__import__("sys").stderr, flush=True)
+            import time as _time
+
+            _time.sleep(delay_ms / 1e3)
+    metrics.gauge_set(None, "distributed_init_retries", tries - 1)
+    raise RuntimeError(
+        f"distributed init failed after {tries} attempts") from last
+
+
+def _teardown_distributed() -> None:
+    """Best-effort shutdown between init retries: jax.distributed.initialize
+    is once-only per live client, so a failed rendezvous must release its
+    half-open state before the next attempt."""
+    global _MULTIHOST_INITIALIZED
+    _MULTIHOST_INITIALIZED = False
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+def _init_timeout_kwargs() -> dict:
+    """initialization_timeout for jax versions that accept it: bounds one
+    rendezvous attempt so ensure_distributed's retry loop gets control
+    back (RDFIND_INIT_TIMEOUT_S; 0/unset keeps jax's default)."""
+    try:
+        t = float(os.environ.get("RDFIND_INIT_TIMEOUT_S", "0"))
+    except ValueError:
+        t = 0.0
+    return {"initialization_timeout": int(t)} if t > 0 else {}
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -335,7 +417,7 @@ def host_gather_many(xs) -> list:
     return faults.guarded_pull(lambda: [_host_gather_raw(x) for x in xs])
 
 
-def allgather_host_values(values) -> np.ndarray:
+def allgather_host_values(values, site: str = "allgather") -> np.ndarray:
     """(n_hosts, k) matrix of per-host floats: one tiny DCN allgather under
     a multi-process runtime, the identity single-process.
 
@@ -343,13 +425,21 @@ def allgather_host_values(values) -> np.ndarray:
     breakdown are HOST-side clocks, so they cannot fuse into the device
     telemetry lanes) — the payload is a handful of float64s, noise next to
     the pass's own counter pull.
-    """
-    arr = np.asarray(values, np.float64).reshape(1, -1)
-    if jax.process_count() == 1:
-        return arr
-    from jax.experimental import multihost_utils
 
-    out = np.asarray(multihost_utils.process_allgather(arr))
+    `site` names the caller for the collective watchdog (and the
+    wedge@<site> fault family): the deadman is armed around the gather, so
+    a peer that never answers becomes a recoverable preemption instead of
+    an indefinite block.
+    """
+    from ..runtime import watchdog
+
+    arr = np.asarray(values, np.float64).reshape(1, -1)
+    with watchdog.collective(site, arr.nbytes * jax.process_count()):
+        if jax.process_count() == 1:
+            return arr
+        from jax.experimental import multihost_utils
+
+        out = np.asarray(multihost_utils.process_allgather(arr))
     return out.reshape(-1, arr.shape[1])
 
 

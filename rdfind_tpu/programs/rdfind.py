@@ -258,8 +258,11 @@ def main(argv=None) -> int:
         # Join the multi-host runtime before anything touches the backend;
         # the mesh then spans every host's devices and --dop defaults to all
         # of them.
-        from ..parallel.mesh import initialize_multihost
-        initialize_multihost(args.coordinator, args.num_hosts, args.host_index)
+        # ensure_distributed = initialize_multihost + bounded retry with
+        # jittered backoff around the rendezvous (gloo wedges on loaded
+        # boxes) + the collective watchdog's deadman.
+        from ..parallel.mesh import ensure_distributed
+        ensure_distributed(args.coordinator, args.num_hosts, args.host_index)
         import jax
         if args.dop == 1:
             args.dop = jax.device_count()

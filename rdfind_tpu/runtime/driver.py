@@ -373,15 +373,20 @@ def _trivial_cind_mask(table: CindTable) -> np.ndarray:
 
 
 def _all_hosts_agree(flag: bool) -> bool:
-    """True iff `flag` is True on EVERY host (one tiny DCN allgather)."""
+    """True iff `flag` is True on EVERY host (one tiny DCN allgather,
+    deadman-armed: a peer that never votes becomes a recoverable
+    preemption instead of an indefinite block)."""
     import jax
+
+    from . import watchdog
 
     if jax.process_count() == 1:
         return flag
     from jax.experimental import multihost_utils
 
-    hits = np.asarray(multihost_utils.process_allgather(
-        np.asarray([flag], np.int32))).reshape(-1)
+    with watchdog.collective("allgather", 4 * jax.process_count()):
+        hits = np.asarray(multihost_utils.process_allgather(
+            np.asarray([flag], np.int32))).reshape(-1)
     return bool(hits.min())
 
 
@@ -677,6 +682,8 @@ def _run_supervised(cfg: Config) -> RunResult:
     path for an external orchestrator to restart us."""
     from . import faults
 
+    from . import watchdog
+
     budget = _retry_budget(cfg)
     attempt = 0
     while True:
@@ -689,10 +696,14 @@ def _run_supervised(cfg: Config) -> RunResult:
                 out.counters["supervisor-attempts"] = attempt
                 metrics.struct_update(None, "elastic_resume",
                                       supervisor_attempts=attempt)
+                # The recovery window is over: the re-entered attempt
+                # finished, so tpu_watch stops reporting RECOVERING.
+                tracer.set_status(recovering=False)
                 if cfg.counter_level >= 1:
                     # The counter report already printed inside the attempt,
                     # before this counter existed.
                     print(f"supervisor-attempts: {attempt}", file=sys.stderr)
+            watchdog.publish(None)
             return out
         except (faults.Preempted, faults.FallbackRequired) as e:
             attempt += 1
@@ -702,6 +713,14 @@ def _run_supervised(cfg: Config) -> RunResult:
             # propagates, but a FallbackRequired that escaped the discover
             # entry point may not have.
             checkpoint.flush_all_progress()
+            # A watchdog-converted wedge leaves its fire state + peer
+            # marker behind; clear both so the re-entered attempt's first
+            # collective is not insta-aborted, and stamp the heartbeat so
+            # tpu_watch --status reports RECOVERING while we re-enter.
+            watchdog.clear_fired()
+            watchdog.clear_markers()
+            tracer.set_status(recovering=True)
+            tracer.heartbeat_now()
             metrics.counter_add(None, "preempt_supervisor_retries")
             metrics.struct_update(None, "elastic_resume",
                                   supervisor_attempts=attempt)

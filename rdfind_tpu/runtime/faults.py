@@ -91,6 +91,9 @@ class FallbackRequired(FaultError):
 
 
 # Every registered injection site (the chaos sweep parametrizes over these).
+# The wedge@<site> family mirrors runtime/watchdog.COLLECTIVE_SITES: one
+# host sleeps "forever" inside the named collective's armed window, and
+# only the watchdog's deadman can convert the hang into Preempted.
 SITES = (
     "overflow@lines",      # P2 freq/exchange-A verdict (sharded._Pipeline)
     "overflow@captures",   # P3 exchange-B verdict
@@ -102,6 +105,15 @@ SITES = (
     "preempt@discover",    # pass-commit boundary of the pass executor
     "flip@host_pull",      # silent corruption: one bit in a pulled block
     "flip@snapshot",       # silent corruption: one bit in a loaded snapshot
+    "wedge@freq",          # P2 line-build exchange dispatch/pull
+    "wedge@captures",      # P3 exchange-B dispatch/pull
+    "wedge@rebalance",     # P2b hot-line move dispatch/pull
+    "wedge@pairs",         # pass-executor counters/blocks pull
+    "wedge@sketch",        # half-approx count-min allreduce
+    "wedge@pass_commit",   # coalesced per-pass allgather (skew + digests)
+    "wedge@resume_vote",   # elastic-resume snapshot vote
+    "wedge@allgather",     # any other mesh.allgather_host_values rider
+    "wedge@init",          # jax.distributed.initialize rendezvous
 )
 
 
@@ -220,6 +232,19 @@ def maybe_preempt(site: str, pass_idx: int | None = None) -> None:
         raise Preempted(f"injected preemption at {site}"
                         + (f" (pass={pass_idx})" if pass_idx is not None
                            else ""))
+
+
+def maybe_wedge(site: str, pass_idx: int | None = None) -> None:
+    """Simulated wedged collective: when an armed ``wedge@<site>`` fault
+    fires, this host blocks inside the collective's armed watchdog window
+    (watchdog.wedge_wait) until the deadman converts the hang into
+    Preempted — the differential test for every wedge-recovery path.
+    Called from inside watchdog.collective()'s guard, so the timer is
+    always armed around the sleep."""
+    if fires(f"wedge@{site}", pass_idx):
+        from . import watchdog
+
+        watchdog.wedge_wait(site)
 
 
 def overflow_injected(site: str, pass_idx: int | None = None) -> bool:

@@ -113,6 +113,17 @@ elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/delta_parity.py; then
     exit 1
 fi
 
+echo "== watchdog parity (injected wedge -> bounded recoverable preemption) =="
+# A wedged collective must convert to Preempted within the watchdog timeout
+# (never an indefinite stall), flush committed passes, and the re-entered
+# run must resume bit-identical.  VERIFY_SKIP_WATCHDOG=1 opts out.
+if [ "${VERIFY_SKIP_WATCHDOG:-0}" = "1" ]; then
+    echo "verify: watchdog parity skipped (VERIFY_SKIP_WATCHDOG=1)"
+elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/watchdog_parity.py; then
+    echo "verify: watchdog parity FAILED" >&2
+    exit 1
+fi
+
 if [ "${VERIFY_SKIP_BENCH:-0}" = "1" ]; then
     echo "verify: tier-1 green; bench + sentinel skipped (VERIFY_SKIP_BENCH=1)"
     exit 0

@@ -1,0 +1,116 @@
+"""Collective-watchdog parity gate (wedge -> recoverable preemption).
+
+Tiny workload on the CPU proxy (8 fake devices): a wedge is injected inside
+the pass executor's armed counters pull (wedge@pairs) with a small watchdog
+floor — the deadman must convert the hang into Preempted within a bounded
+burn (never the indefinite stall it replaces), flush the committed passes,
+and a re-entered run must resume (resumed_passes > 0) bit-identical to a
+never-wedged single-device reference.  The degradation ledger must carry
+the wedged@pairs stamp and the watchdog counters must land in stats.
+scripts/verify.sh runs this next to elastic_resume_parity;
+VERIFY_SKIP_WATCHDOG=1 opts out.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# Small pass budget so the wedge lands mid-phase with passes to resume.
+os.environ["RDFIND_PAIR_ROW_BUDGET"] = "8192"
+os.environ["RDFIND_BACKOFF_BASE_MS"] = "1"
+os.environ["RDFIND_WATCHDOG"] = "1"
+# Bounded burn: generous against cold-compile stalls inside armed windows
+# (this gate compiles its programs from scratch), tiny against the
+# multi-hour hang a real wedge used to cost.
+os.environ["RDFIND_COLLECTIVE_TIMEOUT_S"] = "30"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    from rdfind_tpu.models import allatonce, sharded
+    from rdfind_tpu.parallel.mesh import make_mesh
+    from rdfind_tpu.runtime import checkpoint, faults, watchdog
+    from rdfind_tpu.utils.synth import generate_triples
+
+    failures = []
+    triples = generate_triples(300, seed=21, n_predicates=8, n_entities=32)
+    ref = allatonce.discover(triples, 2).to_rows()
+    if not ref:
+        failures.append("workload produced 0 CINDs (gate is vacuous)")
+    mesh = make_mesh(8)
+    # Warm the jit cache so the wedged run's armed windows hold collectives,
+    # not compiles — the burn bound below then measures the watchdog, and a
+    # legitimately slow compile cannot false-fire the 30 s floor.
+    sharded.discover_sharded(triples, 2, mesh=mesh)
+
+    with tempfile.TemporaryDirectory() as root:
+        def progress():
+            return checkpoint.ProgressStore(
+                checkpoint.CheckpointStore(os.path.join(root, "w")), "base")
+
+        # 3rd pairs-guard hit = pass 1 counters (counters + blocks per
+        # pass): pass 0 has committed, so the resume must skip it.
+        os.environ["RDFIND_FAULTS"] = "wedge@pairs:nth=3"
+        faults.reset()
+        watchdog.reset()
+        stats = {}
+        t0 = time.monotonic()
+        try:
+            sharded.discover_sharded(triples, 2, mesh=mesh, stats=stats,
+                                     progress=progress())
+            failures.append("planted wedge never fired")
+        except faults.Preempted:
+            burn = time.monotonic() - t0
+            if burn > 120.0:
+                failures.append(f"wedge burn {burn:.0f}s is not bounded by "
+                                "the watchdog timeout")
+        finally:
+            os.environ.pop("RDFIND_FAULTS", None)
+            faults.reset()
+
+        degr = [d for d in stats.get("degradations", [])
+                if d.get("phase") == "watchdog"]
+        if not degr or degr[-1].get("action") != "wedged@pairs":
+            failures.append(f"degradation ledger missing wedged@pairs "
+                            f"({stats.get('degradations')})")
+        if not watchdog.fired("pairs"):
+            failures.append("watchdog.fired('pairs') is False after the fire")
+
+        # Supervisor protocol, then the re-entered attempt.
+        watchdog.clear_fired()
+        watchdog.clear_markers()
+        s2 = {}
+        rows = sharded.discover_sharded(triples, 2, mesh=mesh, stats=s2,
+                                        progress=progress()).to_rows()
+        if s2.get("resumed_passes", 0) < 1:
+            failures.append("re-entered run resumed no committed passes "
+                            "(the fire path must flush progress)")
+        wd = s2.get("watchdog", {})
+        if wd.get("fired", 0) < 1:
+            failures.append(f"stats['watchdog'] counters missing ({wd})")
+        if rows != ref:
+            failures.append("recovered CIND table differs from the "
+                            "never-wedged reference")
+
+    if failures:
+        for f in failures:
+            print(f"watchdog_parity: {f}", file=sys.stderr)
+        return 1
+    print(f"watchdog_parity: OK — wedge@pairs converted to Preempted, "
+          f"re-entry resumed committed passes, {len(ref)} CIND rows "
+          "bit-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

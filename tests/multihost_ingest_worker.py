@@ -30,7 +30,7 @@ def main():
     from rdfind_tpu.parallel import mesh as mesh_mod
     from rdfind_tpu.runtime import multihost_ingest
 
-    mesh_mod.initialize_multihost(f"127.0.0.1:{port}", nproc, pid)
+    mesh_mod.ensure_distributed(f"127.0.0.1:{port}", nproc, pid)
     mesh = mesh_mod.make_mesh()
     g_triples, g_valid, dictionary, total = multihost_ingest.sharded_ingest(
         paths, mesh, partition_dictionary=(mode == "partitioned"))

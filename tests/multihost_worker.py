@@ -25,7 +25,7 @@ def main():
     from rdfind_tpu.parallel import mesh as mesh_mod
     from rdfind_tpu.utils.synth import generate_triples
 
-    mesh_mod.initialize_multihost(f"127.0.0.1:{port}", nproc, pid)
+    mesh_mod.ensure_distributed(f"127.0.0.1:{port}", nproc, pid)
     assert jax.device_count() == 4 * nproc
     mesh = mesh_mod.make_mesh()
     triples = generate_triples(200, seed=3, n_predicates=6, n_entities=24)

@@ -18,7 +18,7 @@ import jax
 
 from rdfind_tpu.models import allatonce, sharded
 from rdfind_tpu.parallel.mesh import make_mesh
-from rdfind_tpu.runtime import checkpoint, faults
+from rdfind_tpu.runtime import checkpoint, faults, watchdog
 from rdfind_tpu.utils.synth import generate_triples
 
 
@@ -33,10 +33,13 @@ def _clean_faults(monkeypatch):
     """Every test starts and ends fault-free, with near-zero backoff."""
     monkeypatch.delenv("RDFIND_FAULTS", raising=False)
     monkeypatch.delenv("RDFIND_STRICT", raising=False)
+    monkeypatch.delenv("RDFIND_WATCHDOG", raising=False)
     monkeypatch.setenv("RDFIND_BACKOFF_BASE_MS", "1")
     faults.reset()
+    watchdog.reset()
     yield
     faults.reset()
+    watchdog.reset()
 
 
 def _arm(monkeypatch, spec):
@@ -264,6 +267,23 @@ _CHAOS_SPECS = {
     # test_integrity.py's flip sweep.
     "flip@host_pull": "flip@host_pull:nth=1",
     "flip@snapshot": "flip@snapshot:times=1",
+    # The wedge family: one host sleeps "forever" inside the named
+    # collective's armed window; only the watchdog deadman (armed below for
+    # these sites, with a small floor so the sweep's burn stays bounded)
+    # converts the hang into Preempted, and the re-entered run must be
+    # bit-identical.  Sites single-process runs never reach (resume_vote
+    # votes only multi-process, init never rendezvouses, the generic
+    # allgather rider and sketch depend on the strategy) stay
+    # armed-and-unfired — the differential still must hold.
+    "wedge@freq": "wedge@freq:nth=1",
+    "wedge@captures": "wedge@captures:nth=1",
+    "wedge@rebalance": "wedge@rebalance:nth=1",
+    "wedge@pairs": "wedge@pairs:nth=1",
+    "wedge@sketch": "wedge@sketch:nth=1",
+    "wedge@pass_commit": "wedge@pass_commit:nth=1",
+    "wedge@resume_vote": "wedge@resume_vote:nth=1",
+    "wedge@allgather": "wedge@allgather:nth=1",
+    "wedge@init": "wedge@init:nth=1",
 }
 
 
@@ -291,7 +311,16 @@ def test_chaos_sweep_every_site(mesh8, tmp_path, monkeypatch, site,
     monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
     if site.startswith("flip"):
         monkeypatch.setenv("RDFIND_INTEGRITY", "1")
+    if site.startswith("wedge"):
+        monkeypatch.setenv("RDFIND_WATCHDOG", "1")
+        monkeypatch.setenv("RDFIND_COLLECTIVE_TIMEOUT_S", "5")
+        if site == "wedge@pass_commit":
+            # The coalesced commit collective only runs with a consumer
+            # aboard; integrity's digest agreement is one.
+            monkeypatch.setenv("RDFIND_INTEGRITY", "1")
     for name, fn in _SHARDED_STRATEGIES:
+        if site.startswith("wedge"):
+            watchdog.reset()
         prog_dir = tmp_path / site.replace("@", "_") / name
         _arm(monkeypatch, _CHAOS_SPECS[site])
         try:

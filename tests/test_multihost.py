@@ -7,7 +7,6 @@ boundary over the distributed runtime (the DCN path of SURVEY §2h).
 
 import json
 import os
-import shutil
 import socket
 import subprocess
 import sys
@@ -110,104 +109,46 @@ def _cpu_env(fake_devices: int | None = None):
         flags.append(f"--xla_force_host_platform_device_count={fake_devices}")
     if flags:
         env["XLA_FLAGS"] = " ".join(flags)
+    # Bound wedges in the PRODUCT, not the test harness: a rendezvous that
+    # stalls (one-core box starves a worker mid-handshake) times out per
+    # attempt and ensure_distributed retries it with a fresh client; a
+    # mid-run collective that wedges trips the watchdog instead of riding
+    # out the full communicate() timeout.  Ceilings stay below _run_procs'
+    # 540s backstop so the burn is watchdog-bounded, not stall-bounded.
+    env.setdefault("RDFIND_INIT_TIMEOUT_S", "150")
+    env.setdefault("RDFIND_INIT_RETRIES", "3")
+    env.setdefault("RDFIND_COLLECTIVE_TIMEOUT_S", "300")
     return env
 
 
-def _run_procs(cmds, env, timeout=540, retries=1, on_retry=None, want_rc=0):
+def _run_procs(cmds, env, timeout=540, want_rc=0):
     """Spawn one process per command, gather (stdout, stderr), assert rc.
 
-    On a communicate() timeout every peer is killed before the retry — a hung
-    coordinated worker must not leak and wedge later tests.  One retry covers
-    coordination-service infrastructure flakes (gloo "Connection closed by
-    peer" / heartbeat timeouts, and full rendezvous wedges that ride out the
-    per-attempt timeout, when the one-core box starves a worker of CPU
-    mid-rendezvous); a deterministic failure still fails both attempts.
-    `on_retry` runs before each retry attempt so tests with on-disk side
-    effects (checkpoint dirs) can restore their pre-attempt state — a dead
-    attempt's partial checkpoints must not leak into the retry and flip its
-    resume assertions."""
-    last_err = None
-    for attempt in range(retries + 1):
-        if attempt and on_retry is not None:
-            on_retry()
-        procs = [subprocess.Popen(c, cwd=_REPO, stdout=subprocess.PIPE,
-                                  stderr=subprocess.PIPE, text=True, env=env)
-                 for c in cmds]
-        try:
-            with ThreadPoolExecutor(len(procs)) as ex:
-                outs = list(ex.map(lambda p: p.communicate(timeout=timeout),
-                                   procs))
-        except subprocess.TimeoutExpired:
-            # Reap every worker, not just the one that timed out, then treat
-            # the wedge like any other infra flake: one fresh retry.
-            for p in procs:
-                p.kill()
-            for p in procs:
-                p.wait()
-            last_err = f"workers wedged past the {timeout}s timeout"
-            continue
-        except Exception:
-            for p in procs:
-                p.kill()
-            raise
-        if all(p.returncode == want_rc for p in procs):
-            return outs
-        last_err = next(err for p, (_, err) in zip(procs, outs)
-                        if p.returncode != want_rc)
-    raise AssertionError(f"worker failed:\n{last_err[-2000:]}")
-
-
-def _dir_restorer(path):
-    """Capture a directory's state NOW; the returned callable restores it.
-
-    Handed to _run_procs as on_retry by the checkpointed tests: an
-    infrastructure-flake retry must see the same on-disk state the failed
-    attempt started from, not whatever partial checkpoints it left behind.
-    The snapshot is RECURSIVE: checkpoint stores grow per-attempt scratch
-    subdirectories (tmp spill dirs, per-stage npz under nested layouts), and
-    a top-level-only snapshot silently leaked those into the retry — the
-    intermittent resume-assertion flips PR 13 noted."""
-    snap = ({str(p.relative_to(path)): p.read_bytes()
-             for p in sorted(path.rglob("*")) if p.is_file()}
-            if path.exists() else None)
-
-    def restore():
-        if path.exists():
-            shutil.rmtree(path)
-        if snap is not None:
-            path.mkdir()
-            for name, data in snap.items():
-                dest = path / name
-                dest.parent.mkdir(parents=True, exist_ok=True)
-                dest.write_bytes(data)
-    return restore
-
-
-def test_dir_restorer_recursive(tmp_path):
-    """The retry restorer's contract: files a failed attempt created vanish
-    (including nested ones), files it modified or deleted come back byte
-    for byte, and a restorer for a not-yet-existing dir removes the dir."""
-    d = tmp_path / "ck"
-    (d / "sub").mkdir(parents=True)
-    (d / "stage.npz").write_bytes(b"base")
-    (d / "sub" / "part.npz").write_bytes(b"nested")
-    restore = _dir_restorer(d)
-    (d / "stage.npz").write_bytes(b"CLOBBERED")
-    (d / "sub" / "part.npz").unlink()
-    (d / "leak.npz").write_bytes(b"partial")
-    (d / "sub2").mkdir()
-    (d / "sub2" / "leak2.npz").write_bytes(b"partial")
-    restore()
-    assert (d / "stage.npz").read_bytes() == b"base"
-    assert (d / "sub" / "part.npz").read_bytes() == b"nested"
-    assert not (d / "leak.npz").exists()
-    assert not (d / "sub2").exists()
-    absent = tmp_path / "never"
-    restore_absent = _dir_restorer(absent)
-    absent.mkdir()
-    (absent / "x").write_bytes(b"y")
-    restore_absent()
-    assert not absent.exists()
+    Single attempt: rendezvous wedges are retried by the PRODUCT
+    (mesh.ensure_distributed re-runs a timed-out jax.distributed.initialize
+    with backoff) and mid-run collective wedges are converted to bounded
+    preemptions by the collective watchdog, so the harness no longer needs
+    its own retry-plus-checkpoint-restore machinery.  On a communicate()
+    timeout every peer is still killed — a hung coordinated worker must not
+    leak and wedge later tests."""
+    procs = [subprocess.Popen(c, cwd=_REPO, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=env)
+             for c in cmds]
+    try:
+        with ThreadPoolExecutor(len(procs)) as ex:
+            outs = list(ex.map(lambda p: p.communicate(timeout=timeout),
+                               procs))
+    except BaseException:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait()
+        raise
+    bad = next((err for p, (_, err) in zip(procs, outs)
+                if p.returncode != want_rc), None)
+    if bad is not None:
+        raise AssertionError(f"worker failed:\n{bad[-2000:]}")
+    return outs
 
 
 def _run_ingest_workers(paths, mode: str, strategy: str = "0"):
@@ -404,8 +345,7 @@ def test_two_process_sharded_ingest_checkpoint_resume(tmp_path):
               "--checkpoint-dir", str(ck), "--output", str(out),
               "--coordinator", f"127.0.0.1:{port}",
               "--num-hosts", "2", "--host-index", str(pid)]
-             for pid in range(2)], _cpu_env(fake_devices=4),
-            on_retry=_dir_restorer(ck))
+             for pid in range(2)], _cpu_env(fake_devices=4))
         return out.read_text(), outs[0][1]
 
     first_out, first_err = run("first")
@@ -457,8 +397,7 @@ def test_two_process_preempt_kill_then_vote_resume(tmp_path):
               "--checkpoint-dir", str(ck), "--output", str(out),
               "--coordinator", f"127.0.0.1:{port}",
               "--num-hosts", "2", "--host-index", str(pid)]
-             for pid in range(2)], env,
-            on_retry=_dir_restorer(ck), want_rc=want_rc)
+             for pid in range(2)], env, want_rc=want_rc)
         return out
 
     run("killed", "preempt@discover:pass=1", 75)
@@ -475,7 +414,7 @@ def test_two_process_preempt_kill_then_vote_resume(tmp_path):
           "--checkpoint-dir", str(ck), "--output", str(out),
           "--coordinator", f"127.0.0.1:{port}",
           "--num-hosts", "2", "--host-index", str(pid)]
-         for pid in range(2)], env, on_retry=_dir_restorer(ck))
+         for pid in range(2)], env)
     resumed = dict(l.split(": ", 1) for l in outs[0][1].splitlines()
                    if l.startswith("stat-resumed_passes"))
     assert int(resumed.get("stat-resumed_passes", "0")) > 0, outs[0][1][-2000:]

@@ -412,6 +412,53 @@ def test_tpu_watch_status_corrupt(tmp_path):
     assert payload["hosts"]["0"]["integrity"]["site"] == "host_pull"
 
 
+def test_tpu_watch_status_recovering(tmp_path):
+    """Satellite: a heartbeat carrying the watchdog's recovering flag is a
+    RECOVERING verdict distinct from wedged — the wedge was already
+    converted to a preemption, so the exit code stays 0 while elastic
+    resume is in flight (the exit-code ladder 0/1/2/3 is unchanged)."""
+    import subprocess
+    import sys
+
+    d = str(tmp_path)
+    heartbeat.write(d, {
+        "stage": "pair-phase", "pass": 1,
+        "watchdog": "wedged@pairs", "recovering": True}, host_index=0)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, (r.stdout, r.stderr)  # recovering != wedged
+    assert "RECOVERING" in r.stdout and "wedged@pairs" in r.stdout
+    assert "elastic resume" in r.stdout
+    # A genuinely stale recovering run still reads wedged (exit 1): the
+    # RECOVERING verdict must not mask a resume that itself stalled.
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d,
+         "--stale-s", "0"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d,
+         "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    payload = json.loads(r.stdout)
+    assert payload["recovering"] is True
+    assert payload["hosts"]["0"]["watchdog"] == "wedged@pairs"
+    # A final beat clears the verdict: a run that recovered AND finished is
+    # plain done, not still-recovering.
+    heartbeat.Heartbeat(d, host_index=0).beat(
+        {"stage": "emit", "watchdog": "wedged@pairs", "recovering": True},
+        final=True)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d,
+         "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert json.loads(r.stdout)["recovering"] is False
+
+
 # ---------------------------------------------------------------------------
 # Disabled-path overhead.
 # ---------------------------------------------------------------------------

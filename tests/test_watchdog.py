@@ -1,0 +1,396 @@
+"""Collective watchdog (runtime/watchdog.py): a wedged collective becomes a
+recoverable preemption.
+
+Fast tier: timeout scaling against the probed link capacity, the enable
+knob, the disabled path's cost bound (the ISSUE's <2% acceptance), the full
+fire path (injected wedge -> Preempted + degradation ledger + wedge marker
++ bounded burn), near-miss accounting, peer-marker aborts, a wedge-recovery
+differential through the real sharded pipeline, the coalesced pass-commit
+collective count pin, and ensure_distributed's bounded rendezvous retry.
+The chaos-tier wedge@<site> sweep rides tests/test_faults.py's existing
+every-site sweep.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from rdfind_tpu.models import sharded
+from rdfind_tpu.obs import metrics
+from rdfind_tpu.parallel import mesh
+from rdfind_tpu.parallel.mesh import make_mesh
+from rdfind_tpu.runtime import checkpoint, faults, watchdog
+from rdfind_tpu.utils.synth import generate_triples
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 CPU devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_watchdog(monkeypatch):
+    """Every test starts and ends with the watchdog disarmed and fault-free
+    (the monitor thread is process-global; stale fire state must not leak)."""
+    for k in ("RDFIND_FAULTS", "RDFIND_WATCHDOG", "RDFIND_WATCHDOG_DIR",
+              "RDFIND_COLLECTIVE_TIMEOUT_S", "RDFIND_WATCHDOG_NEARMISS_FRAC",
+              "RDFIND_WATCHDOG_EXIT", "RDFIND_WATCHDOG_GRACE_S"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("RDFIND_BACKOFF_BASE_MS", "1")
+    faults.reset()
+    watchdog.reset()
+    watchdog.bind_stats(None)
+    yield
+    faults.reset()
+    watchdog.reset()
+    watchdog.bind_stats(None)
+    metrics.clear_link_caps()
+
+
+def _workload():
+    # Same shape as test_faults' multipass workload: the jitted pass
+    # programs are shared through the process-wide jit cache.
+    return generate_triples(300, seed=21, n_predicates=8, n_entities=32)
+
+
+def _progress(tmp_path, name="p"):
+    return checkpoint.ProgressStore(
+        checkpoint.CheckpointStore(str(tmp_path / name)), "base")
+
+
+# ---------------------------------------------------------------------------
+# Timeout scaling + the enable knob.
+# ---------------------------------------------------------------------------
+
+
+def test_timeout_floor_and_payload_scaling(monkeypatch):
+    assert watchdog.timeout_floor_s() == 120.0  # default
+    monkeypatch.setenv("RDFIND_COLLECTIVE_TIMEOUT_S", "5")
+    assert watchdog.timeout_floor_s() == 5.0
+    # No probe cached: the floor alone applies at any payload size.
+    metrics.clear_link_caps()
+    assert watchdog.timeout_s(0) == 5.0
+    assert watchdog.timeout_s(10**12) == 5.0
+    # With a probed capacity the slowest hop sets the wire time: 1 GB over
+    # the 1 gbps DCN hop is 1 s on the wire -> 16 s with slack, above the
+    # floor; a tiny vote stays on the floor.
+    metrics.set_link_caps({"dcn_gbps": 1.0, "ici_gbps": 8.0})
+    assert watchdog.timeout_s(10**9) == pytest.approx(16.0)
+    assert watchdog.timeout_s(64) == 5.0
+    # A garbage env value falls back to the default rather than raising.
+    monkeypatch.setenv("RDFIND_COLLECTIVE_TIMEOUT_S", "nope")
+    assert watchdog.timeout_floor_s() == 120.0
+
+
+def test_enabled_knob_and_guard_selection(monkeypatch):
+    # Single-process auto: off (no peer to wedge against).
+    assert jax.process_count() == 1
+    assert not watchdog.enabled()
+    g = watchdog.collective("pairs", 128)
+    assert g is watchdog._NULL_GUARD
+    with g:
+        pass
+    monkeypatch.setenv("RDFIND_WATCHDOG", "1")
+    assert watchdog.enabled()
+    armed = watchdog.collective("pairs", 128)
+    assert isinstance(armed, watchdog._Guard)
+    with armed:
+        pass
+    assert watchdog.snapshot()["armed"] == 1
+    monkeypatch.setenv("RDFIND_WATCHDOG", "0")
+    assert not watchdog.enabled()
+    # force=True arms regardless (the init rendezvous knows it is
+    # multi-process before jax does).
+    assert isinstance(watchdog.collective("init", force=True),
+                      watchdog._Guard)
+
+
+def test_disabled_guard_overhead_under_2pct(mesh8):
+    """The acceptance bound, via the measured-quantities idiom of
+    test_obs.test_disabled_tracing_overhead_under_2pct: (disabled-path cost
+    per guard) x (guards per pass) x n_pass under 2% of the pipeline's
+    measured wall clock — a future 'cheap' feature cannot quietly put real
+    work on the per-dispatch path."""
+    assert not watchdog.enabled()
+    triples = _workload()
+    stats: dict = {}
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)  # warm
+    stats = {}
+    t0 = time.perf_counter()
+    sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    wall_s = time.perf_counter() - t0
+
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with watchdog.collective("pairs", 4096):
+            pass
+    per_hit_s = (time.perf_counter() - t0) / n
+    assert per_hit_s < 25e-6, f"{per_hit_s * 1e6:.2f}us per disabled guard"
+    # Per committed pass the executor arms <= 3 guards (counters pull,
+    # blocks pull, pass-commit allgather) + the per-phase exchange guards;
+    # 8 is generous headroom.
+    hits = 8 * max(stats.get("n_pair_passes", 1), 1)
+    overhead = hits * per_hit_s
+    assert overhead / wall_s < 0.02, (
+        f"disabled watchdog path costs {overhead * 1e3:.3f}ms over "
+        f"{wall_s * 1e3:.0f}ms wall ({overhead / wall_s:.2%})")
+
+
+# ---------------------------------------------------------------------------
+# The fire path.
+# ---------------------------------------------------------------------------
+
+
+def test_wedge_fires_bounded_and_recoverable(monkeypatch, tmp_path):
+    """An injected wedge inside an armed collective converts to Preempted
+    within the (tiny) timeout: flight evidence out, degradation ledger
+    stamped, wedge marker written — then clear_fired/clear_markers restore
+    a clean slate and the same collective completes."""
+    monkeypatch.setenv("RDFIND_WATCHDOG", "1")
+    monkeypatch.setenv("RDFIND_COLLECTIVE_TIMEOUT_S", "0.3")
+    monkeypatch.setenv("RDFIND_WATCHDOG_DIR", str(tmp_path))
+    monkeypatch.setenv("RDFIND_FAULTS", "wedge@resume_vote:nth=1")
+    faults.reset()
+    stats: dict = {}
+    watchdog.bind_stats(stats)
+    t0 = time.monotonic()
+    with pytest.raises(faults.Preempted):
+        mesh.allgather_host_values([1.0, 2.0], site="resume_vote")
+    burn = time.monotonic() - t0
+    assert burn < 10.0, "the burn must be watchdog-bounded, not a stall"
+    snap = watchdog.snapshot()
+    assert snap["fired"] == 1
+    assert "resume_vote" in snap["fired_sites"]
+    assert snap["max_wait_s"]["resume_vote"] >= 0.3
+    assert watchdog.fired("resume_vote") and watchdog.fired()
+    degr = stats["degradations"]
+    assert degr[-1]["phase"] == "watchdog"
+    assert degr[-1]["action"] == "wedged@resume_vote"
+    markers = watchdog.read_markers(str(tmp_path))
+    assert markers[0]["site"] == "resume_vote"
+    # publish lands the struct for the stats plane.
+    watchdog.publish(stats)
+    assert stats["watchdog"]["fired"] == 1
+    # Supervisor protocol: clear fire state + markers, then re-enter.
+    watchdog.clear_fired()
+    watchdog.clear_markers(str(tmp_path))
+    assert not watchdog.fired()
+    assert not watchdog.read_markers(str(tmp_path))
+    monkeypatch.delenv("RDFIND_FAULTS")
+    faults.reset()
+    out = mesh.allgather_host_values([1.0, 2.0], site="resume_vote")
+    assert out.shape == (1, 2) and out[0, 1] == 2.0
+
+
+def test_near_miss_accounting(monkeypatch):
+    """A collective that completes but consumed more than the configured
+    fraction of its timeout is counted (the capacity-planning signal that
+    timeouts are about to start lying), without firing."""
+    monkeypatch.setenv("RDFIND_WATCHDOG", "1")
+    monkeypatch.setenv("RDFIND_COLLECTIVE_TIMEOUT_S", "2.0")
+    monkeypatch.setenv("RDFIND_WATCHDOG_NEARMISS_FRAC", "0.05")
+    with watchdog.collective("pairs", 0):
+        time.sleep(0.15)  # > 5% of 2 s, far under the deadline
+    snap = watchdog.snapshot()
+    assert snap["near_miss"] == 1
+    assert snap["fired"] == 0
+    assert snap["max_wait_s"]["pairs"] >= 0.15
+    # A fast collective is neither a near miss nor a fire.
+    with watchdog.collective("pairs", 0):
+        pass
+    assert watchdog.snapshot()["near_miss"] == 1
+
+
+def test_peer_marker_aborts_matching_site(monkeypatch, tmp_path):
+    """A peer's wedge marker aborts this host's armed collective on the
+    MATCHING site well before its own timer (all hosts leave the collective
+    together), without re-marking (no marker ping-pong)."""
+    monkeypatch.setenv("RDFIND_WATCHDOG", "1")
+    monkeypatch.setenv("RDFIND_COLLECTIVE_TIMEOUT_S", "60")
+    monkeypatch.setenv("RDFIND_WATCHDOG_DIR", str(tmp_path))
+    with open(tmp_path / f"{watchdog.MARKER_PREFIX}1.json", "w") as f:
+        json.dump({"site": "pairs", "host": 1, "reason": "timeout"}, f)
+    t0 = time.monotonic()
+    with pytest.raises(faults.Preempted):
+        with watchdog.collective("pairs", 0):
+            for _ in range(1500):  # Python-level wait: async-exc converts
+                time.sleep(0.02)
+    assert time.monotonic() - t0 < 30.0, "peer abort must beat the timer"
+    snap = watchdog.snapshot()
+    assert snap["peer_aborts"] == 1
+    assert snap["fired"] == 1
+    assert snap["fired_sites"]["pairs"] == "peer wedge marker"
+    # Only the originating host's marker exists — the abort did not re-mark.
+    assert sorted(watchdog.read_markers(str(tmp_path))) == [1]
+
+
+def test_peer_marker_other_site_does_not_abort(monkeypatch, tmp_path):
+    monkeypatch.setenv("RDFIND_WATCHDOG", "1")
+    monkeypatch.setenv("RDFIND_COLLECTIVE_TIMEOUT_S", "30")
+    monkeypatch.setenv("RDFIND_WATCHDOG_DIR", str(tmp_path))
+    with open(tmp_path / f"{watchdog.MARKER_PREFIX}1.json", "w") as f:
+        json.dump({"site": "freq", "host": 1, "reason": "timeout"}, f)
+    with watchdog.collective("pairs", 0):
+        time.sleep(1.2)  # > 2 monitor polls: the marker WAS seen, and kept
+    assert watchdog.snapshot()["peer_aborts"] == 0
+    assert not watchdog.fired()
+
+
+def test_wedge_recovery_through_pipeline_bit_identical(mesh8, tmp_path,
+                                                       monkeypatch):
+    """The tentpole differential on the real executor: a wedge injected in
+    the pass executor's counters pull converts to Preempted (committed
+    passes flushed by the fire path), and the re-entered run resumes and
+    produces bit-identical rows."""
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+    ref = sharded.discover_sharded(triples, 2, mesh=mesh8)  # warm + reference
+    monkeypatch.setenv("RDFIND_WATCHDOG", "1")
+    # Generous enough that a legitimately slow warm-cache collective on a
+    # loaded box never false-fires, small enough to bound the wedge burn.
+    monkeypatch.setenv("RDFIND_COLLECTIVE_TIMEOUT_S", "3.0")
+    # 3rd pairs-guard hit = pass 1 counters (2 guard hits per pass): pass 0
+    # has committed, so the resumed run must skip it.
+    monkeypatch.setenv("RDFIND_FAULTS", "wedge@pairs:nth=3")
+    faults.reset()
+    stats: dict = {}
+    t0 = time.monotonic()
+    with pytest.raises(faults.Preempted):
+        sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats,
+                                 progress=_progress(tmp_path))
+    assert time.monotonic() - t0 < 30.0
+    assert stats["degradations"][-1]["action"] == "wedged@pairs"
+    monkeypatch.delenv("RDFIND_FAULTS")
+    faults.reset()
+    watchdog.clear_fired()
+    s2: dict = {}
+    table = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=s2,
+                                     progress=_progress(tmp_path))
+    # The fire path's flush_all_progress persisted pass 0 before Preempted.
+    assert s2["resumed_passes"] >= 1
+    assert s2["watchdog"]["fired"] >= 1  # cumulative counters ride stats
+    assert table.to_rows() == ref.to_rows()
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: the coalesced per-pass commit collective.
+# ---------------------------------------------------------------------------
+
+
+def _discover_counting_collectives(mesh8, monkeypatch, triples):
+    calls: list = []
+    real = mesh.allgather_host_values
+
+    def counting(values, site="allgather"):
+        calls.append(site)
+        return real(values, site=site)
+
+    monkeypatch.setattr(sharded, "allgather_host_values", counting)
+    stats: dict = {}
+    table = sharded.discover_sharded(triples, 2, mesh=mesh8, stats=stats)
+    return calls, stats, table
+
+
+def test_pass_commit_collective_count_pinned(mesh8, monkeypatch):
+    """ONE batched allgather per committed pass carries skew sample AND
+    digest agreement: enabling integrity on top of the skew meter adds ZERO
+    collectives (the gloo many-tiny-collectives abort scales with count),
+    and with neither consumer the pass executor issues none at all."""
+    triples = _workload()
+    monkeypatch.setattr(sharded, "PAIR_ROW_BUDGET", 1 << 13)
+
+    monkeypatch.delenv("RDFIND_COLLECTIVE_TIMING", raising=False)
+    monkeypatch.delenv("RDFIND_INTEGRITY", raising=False)
+    calls, stats, _ = _discover_counting_collectives(
+        mesh8, monkeypatch, triples)
+    assert calls.count("pass_commit") == 0
+
+    monkeypatch.setenv("RDFIND_COLLECTIVE_TIMING", "1")
+    calls_t, stats_t, _ = _discover_counting_collectives(
+        mesh8, monkeypatch, triples)
+    n_pass = stats_t["n_pair_passes"]
+    assert n_pass > 1
+    assert calls_t.count("pass_commit") == n_pass
+
+    monkeypatch.setenv("RDFIND_INTEGRITY", "1")
+    calls_ti, stats_ti, table = _discover_counting_collectives(
+        mesh8, monkeypatch, triples)
+    assert stats_ti["n_pair_passes"] == n_pass
+    assert calls_ti.count("pass_commit") == n_pass, \
+        "digest agreement must ride the SAME collective, not add its own"
+    assert len(calls_ti) == len(calls_t)
+    assert "host_skew" in stats_ti  # both consumers still got their rows
+    assert table.to_rows() is not None
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: bounded distributed-init retry.
+# ---------------------------------------------------------------------------
+
+
+def test_ensure_distributed_single_process_noop(monkeypatch):
+    called = []
+    monkeypatch.setattr(mesh, "initialize_multihost",
+                        lambda *a, **k: called.append(1))
+    assert mesh.ensure_distributed("127.0.0.1:1", 1, 0) == 0
+    assert not called
+
+
+def test_ensure_distributed_retries_then_joins(monkeypatch):
+    attempts = []
+    teardowns = []
+
+    def fake_init(coordinator, num_processes, process_id, *,
+                  shutdown_timeout_seconds=7200):
+        attempts.append((coordinator, num_processes, process_id))
+        if len(attempts) < 3:
+            raise RuntimeError("rendezvous timed out")
+
+    monkeypatch.setattr(mesh, "initialize_multihost", fake_init)
+    monkeypatch.setattr(mesh, "_teardown_distributed",
+                        lambda: teardowns.append(1))
+    monkeypatch.setenv("RDFIND_BACKOFF_BASE_MS", "1")
+    assert mesh.ensure_distributed("127.0.0.1:9", 2, 0) == 2
+    assert len(attempts) == 3 and len(teardowns) == 2
+    assert metrics.registry().snapshot()["distributed_init_retries"] == 2
+
+
+def test_ensure_distributed_exhaustion_and_preempted_passthrough(monkeypatch):
+    monkeypatch.setenv("RDFIND_INIT_RETRIES", "2")
+    monkeypatch.setenv("RDFIND_BACKOFF_BASE_MS", "1")
+    attempts = []
+
+    def always_fail(*a, **k):
+        attempts.append(1)
+        raise RuntimeError("rendezvous timed out")
+
+    monkeypatch.setattr(mesh, "initialize_multihost", always_fail)
+    monkeypatch.setattr(mesh, "_teardown_distributed", lambda: None)
+    with pytest.raises(RuntimeError, match="after 2 attempts"):
+        mesh.ensure_distributed("127.0.0.1:9", 2, 0)
+    assert len(attempts) == 2
+
+    def preempted(*a, **k):
+        raise faults.Preempted("watchdog converted the rendezvous")
+
+    monkeypatch.setattr(mesh, "initialize_multihost", preempted)
+    with pytest.raises(faults.Preempted):
+        mesh.ensure_distributed("127.0.0.1:9", 2, 0)
+
+
+def test_init_timeout_kwargs(monkeypatch):
+    monkeypatch.delenv("RDFIND_INIT_TIMEOUT_S", raising=False)
+    assert mesh._init_timeout_kwargs() == {}
+    monkeypatch.setenv("RDFIND_INIT_TIMEOUT_S", "150")
+    assert mesh._init_timeout_kwargs() == {"initialization_timeout": 150}
+    monkeypatch.setenv("RDFIND_INIT_TIMEOUT_S", "0")
+    assert mesh._init_timeout_kwargs() == {}
+    monkeypatch.setenv("RDFIND_INIT_TIMEOUT_S", "junk")
+    assert mesh._init_timeout_kwargs() == {}

@@ -208,6 +208,18 @@ def _degrading_hosts(hosts: dict) -> dict:
             if isinstance(b.get("forecast"), dict)}
 
 
+def _recovering_hosts(hosts: dict) -> dict:
+    """{host: watchdog-verdict} for hosts whose heartbeat status says the
+    collective watchdog fired and the run is re-entering via elastic resume
+    (runtime/watchdog.py stamps ``recovering=True`` + ``watchdog=wedged@site``
+    on fire; the supervisor clears the flag once a re-entered attempt
+    completes).  RECOVERING is a distinct verdict from "wedged": the stall
+    was already detected and converted to a preemption — the run is expected
+    to come back on its own, so the exit code stays 0."""
+    return {h: (b.get("watchdog") or "wedged@?") for h, b in hosts.items()
+            if b.get("recovering") and not b.get("final")}
+
+
 def _corrupt_hosts(hosts: dict) -> dict:
     """{host: integrity-verdict} for hosts whose heartbeat carries an
     unrepaired integrity-digest mismatch (obs/integrity.note_mismatch pushes
@@ -222,20 +234,23 @@ def _corrupt_hosts(hosts: dict) -> dict:
 def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
     """The wedged-vs-slow verdict over a run's obs directory (exit codes:
     0 alive/done, 1 wedged, 2 no heartbeat at all, 3 CORRUPT — an
-    unrepaired integrity mismatch on some host's heartbeat; "degrading" is
-    reported but never changes the exit code — the run is still making
-    progress)."""
+    unrepaired integrity mismatch on some host's heartbeat; "degrading" and
+    "recovering" are reported but never change the exit code — the run is
+    still making progress, or is expected to come back via elastic
+    resume)."""
     verdict = heartbeat.assess(obs_dir, stale_s=stale_s)
     state = verdict["state"]
     hosts = {
         h: {**b, "stale": b["age_s"] > stale_s and not b.get("final")}
         for h, b in verdict["hosts"].items()}
     degrading = _degrading_hosts(hosts)
+    recovering = _recovering_hosts(hosts)
     corrupt = _corrupt_hosts(hosts)
     recs = _flightrec_summaries(obs_dir)
     if as_json:
         print(json.dumps({"dir": obs_dir, "state": state,
                           "degrading": bool(degrading),
+                          "recovering": bool(recovering),
                           "corrupt": bool(corrupt),
                           "stale_s": stale_s, "age_s": verdict["age_s"],
                           "hosts": hosts, "flightrec": recs},
@@ -271,6 +286,11 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
                              if k != "pass")
             print(f"status[{obs_dir}] host {h}: cap utilization "
                   f"(pass {util.get('pass')}): {caps}")
+        wd = recovering.get(h)
+        if wd is not None:
+            print(f"status[{obs_dir}] host {h}: RECOVERING — collective "
+                  f"watchdog fired ({wd}); converted to a preemption, "
+                  f"elastic resume re-entering")
         fc = degrading.get(h)
         if fc is not None:
             print(f"status[{obs_dir}] host {h}: DEGRADING — cap "
@@ -299,6 +319,10 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
                 f"{sorted(corrupt)})")
     elif state == "wedged":
         tail = f" (no span boundary for > {stale_s:.0f}s — wedged, not slow)"
+    elif recovering:
+        tail = (" (RECOVERING: collective watchdog fired on host(s) "
+                f"{sorted(recovering)} — wedge already converted to a "
+                "preemption, elastic resume in flight)")
     elif degrading:
         tail = (" (degrading: cap-exhaustion forecast active on host(s) "
                 f"{sorted(degrading)} — alive, but the degradation ladder "
