@@ -1,0 +1,239 @@
+"""Benchmark: the mmap'd CIND index's build, open, and query hot paths.
+
+Plants a synthetic CIND workload (~BENCH_SERVE_CINDS dependencies ×
+~8 referenced captures each over a fresh value dictionary — the serving
+shape, no discovery run needed), writes it through
+runtime/serving.write_index at two sizes a 10x spread apart, and measures:
+
+  * open time at both sizes — ASSERTED flat (mmap + header parse only; a
+    size-dependent open means something started materializing sections);
+  * single-thread and multi-thread holds() QPS over a warm reader (hit/miss
+    mix, string captures through the memo + id fast path);
+  * per-query latency p50/p95/p99 for all three query types (holds,
+    referenced, top-k).
+
+Prints ONE JSON line (bench.py shape) and appends a provenance-keyed row
+to BENCH_HISTORY.jsonl; `serve_qps` / `serve_open_ms` / `serve_p99_us`
+gate in obs/sentinel.METRIC_SPECS like kernel regressions.
+
+Env: BENCH_SERVE_CINDS (default 10_000), BENCH_SERVE_QUERIES (default
+50_000), BENCH_SERVE_THREADS (default 4), BENCH_SERVE_MIN_QPS (default
+50_000; the single-thread holds() floor, 0 disables the assert),
+BENCH_HISTORY as in bench.py.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import traceback
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from bench import _record_history  # noqa: E402
+from rdfind_tpu import conditions as cc  # noqa: E402
+from rdfind_tpu.data import NO_VALUE, CindTable  # noqa: E402
+from rdfind_tpu.obs import sentinel as obs_sentinel  # noqa: E402
+from rdfind_tpu.runtime import serving  # noqa: E402
+
+REFS_PER_DEP = 8
+
+
+def _planted(n_cinds: int, seed: int = 7):
+    """(values, table): ~n_cinds CINDs over n_cinds//8 dependents, every
+    capture a distinct value — the dictionary-heavy serving shape."""
+    rng = np.random.default_rng(seed)
+    n_deps = max(1, n_cinds // REFS_PER_DEP)
+    dep_vals = [f"dep:{i:08d}" for i in range(n_deps)]
+    ref_vals = [f"ref:{i:08d}" for i in range(n_deps * REFS_PER_DEP)]
+    values = sorted(dep_vals + ref_vals)
+    vid = {v: i for i, v in enumerate(values)}
+    codes = cc.ALL_VALID_CAPTURE_CODES[:4]
+    rows = []
+    for d in range(n_deps):
+        code_d = codes[d % len(codes)]
+        support = int(rng.integers(2, 1000))
+        for r in range(REFS_PER_DEP):
+            rv = ref_vals[d * REFS_PER_DEP + r]
+            rows.append((code_d, vid[dep_vals[d]], NO_VALUE,
+                         codes[(d + r) % len(codes)], vid[rv], NO_VALUE,
+                         support))
+    return values, CindTable.from_rows(rows)
+
+
+def _open_ms(path: str, repeats: int = 7) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        r = serving.IndexReader(path)
+        best = min(best, (time.perf_counter() - t0) * 1e3)
+        r.close()
+    return best
+
+
+def _percentiles(us: list) -> dict:
+    a = np.asarray(us)
+    return {"p50": round(float(np.percentile(a, 50)), 1),
+            "p95": round(float(np.percentile(a, 95)), 1),
+            "p99": round(float(np.percentile(a, 99)), 1)}
+
+
+def _query_mix(reader, values, table, n_queries: int, seed: int = 11):
+    """[(dep_capture, ref_capture), ...] string-capture pairs, ~2/3 hits
+    and 1/3 misses (a present dependent against a foreign reference)."""
+    rng = np.random.default_rng(seed)
+    t = len(table)
+    pick = rng.integers(0, t, n_queries)
+    miss = rng.random(n_queries) < 1 / 3
+    out = []
+    for i, row in enumerate(pick):
+        dep = (int(table.dep_code[row]), values[int(table.dep_v1[row])],
+               None)
+        j = int(rng.integers(0, t))
+        ref_row = j if miss[i] else int(row)
+        ref = (int(table.ref_code[ref_row]),
+               values[int(table.ref_v1[ref_row])], None)
+        out.append((dep, ref))
+    return out
+
+
+def _run(n_cinds: int, n_queries: int, n_threads: int,
+         min_qps: float) -> dict:
+    detail = {"provenance": obs_sentinel.provenance(backend="cpu"),
+              "n_cinds_requested": n_cinds}
+    serve = {}
+    with tempfile.TemporaryDirectory() as root:
+        small_dir = os.path.join(root, "small")
+        big_dir = os.path.join(root, "big")
+        values_s, table_s = _planted(max(REFS_PER_DEP, n_cinds // 10))
+        values_b, table_b = _planted(n_cinds)
+
+        t0 = time.perf_counter()
+        serving.write_index(small_dir, values_s, table_s, generation=0,
+                            output_digest="bench-small")
+        p_big = None
+        t1 = time.perf_counter()
+        p_big = serving.write_index(big_dir, values_b, table_b,
+                                    generation=0,
+                                    output_digest="bench-big")
+        build_ms = (time.perf_counter() - t1) * 1e3
+        serve["build_small_ms"] = round((t1 - t0) * 1e3, 2)
+        serve["build_ms"] = round(build_ms, 2)
+        p_small = serving.index_path(small_dir)
+        serve["index_bytes_small"] = os.path.getsize(p_small)
+        serve["index_bytes"] = os.path.getsize(p_big)
+
+        # Open must be O(header): flat across the 10x size spread.
+        open_small = _open_ms(p_small)
+        open_big = _open_ms(p_big)
+        serve["open_ms_small"] = round(open_small, 3)
+        serve["open_ms_big"] = round(open_big, 3)
+        serve["open_ms"] = round(open_big, 3)
+        assert open_big < open_small * 4 + 20.0, (
+            f"index open is size-dependent: {open_small:.2f}ms at "
+            f"{serve['index_bytes_small']}B vs {open_big:.2f}ms at "
+            f"{serve['index_bytes']}B — mmap open must be O(header)")
+
+        reader = serving.IndexReader(p_big)
+        serve["n_cinds"] = reader.n_cinds
+        serve["n_values"] = reader.n_values
+        queries = _query_mix(reader, values_b, table_b, n_queries)
+        holds = reader.holds
+        for dep, ref in queries[:2000]:
+            holds(dep, ref)  # warm the value/capture memo
+
+        t0 = time.perf_counter()
+        hits = 0
+        for dep, ref in queries:
+            if holds(dep, ref):
+                hits += 1
+        wall = time.perf_counter() - t0
+        qps = n_queries / wall
+        serve["holds_qps"] = round(qps, 1)
+        serve["holds_hit_frac"] = round(hits / n_queries, 3)
+        print(f"bench_serve: holds() {qps:,.0f} QPS single-thread "
+              f"({n_queries} queries, {hits} hits)", file=sys.stderr,
+              flush=True)
+        if min_qps:
+            assert qps >= min_qps, (
+                f"holds() {qps:,.0f} QPS < the {min_qps:,.0f} floor "
+                f"(BENCH_SERVE_MIN_QPS=0 disables)")
+
+        # Multi-thread: shared reader, per-thread query slices.
+        def worker(slice_, out, i):
+            h = reader.holds
+            for dep, ref in slice_:
+                h(dep, ref)
+            out[i] = True
+
+        chunk = max(1, n_queries // n_threads)
+        slices = [queries[i * chunk:(i + 1) * chunk]
+                  for i in range(n_threads)]
+        done = [False] * n_threads
+        threads = [threading.Thread(target=worker, args=(s, done, i))
+                   for i, s in enumerate(slices)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        mt_wall = time.perf_counter() - t0
+        serve["holds_qps_mt"] = round(
+            sum(len(s) for s in slices) / mt_wall, 1)
+        serve["threads"] = n_threads
+
+        # Per-query latency (timed individually; percentile over a sample).
+        lat_n = min(5000, n_queries)
+        for name, fn in (
+                ("holds", lambda q: holds(q[0], q[1])),
+                ("referenced", lambda q: reader.referenced(q[0], limit=16)),
+                ("topk", lambda q: reader.topk(10, decode=False))):
+            us = []
+            for q in queries[:lat_n]:
+                t0 = time.perf_counter()
+                fn(q)
+                us.append((time.perf_counter() - t0) * 1e6)
+            p = _percentiles(us)
+            serve[f"{name}_p50_us"] = p["p50"]
+            serve[f"{name}_p95_us"] = p["p95"]
+            serve[f"{name}_p99_us"] = p["p99"]
+            print(f"bench_serve: {name} p50/p95/p99 = {p['p50']}/"
+                  f"{p['p95']}/{p['p99']} us", file=sys.stderr, flush=True)
+        reader.close()
+
+    detail["serve"] = serve
+    detail["workload"] = {"bench": "serve", "n_cinds": serve["n_cinds"],
+                          "refs_per_dep": REFS_PER_DEP, "seed": 7}
+    return {
+        "metric": "serve_holds_qps",
+        "value": serve["holds_qps"],
+        "unit": "queries/s",
+        "vs_baseline": serve["holds_qps"],
+        "detail": detail,
+    }
+
+
+def main():
+    n_cinds = int(os.environ.get("BENCH_SERVE_CINDS", 10_000))
+    n_queries = int(os.environ.get("BENCH_SERVE_QUERIES", 50_000))
+    n_threads = int(os.environ.get("BENCH_SERVE_THREADS", 4))
+    min_qps = float(os.environ.get("BENCH_SERVE_MIN_QPS", 50_000))
+    try:
+        result = _run(n_cinds, n_queries, n_threads, min_qps)
+    except Exception as e:
+        tb = traceback.format_exc(limit=3)
+        result = {
+            "metric": "serve_holds_qps", "value": 0, "unit": "queries/s",
+            "vs_baseline": 0,
+            "detail": {"error": f"{type(e).__name__}: {e}",
+                       "traceback": tb.splitlines()[-3:]},
+        }
+    print(json.dumps(result, default=str))
+    _record_history(result)
+
+
+if __name__ == "__main__":
+    main()

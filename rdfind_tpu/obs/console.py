@@ -20,6 +20,18 @@ is alive, one server per host (``RDFIND_CONSOLE_PORT`` or
               counters, mismatch events (obs/integrity.py's structs)
   /flightrec  the crash-surviving ring (obs/flightrec.py), newest last
 
+When a serving process arms an IndexService (set_query_service), the
+console grows from a diagnostics endpoint into the query plane:
+
+  /query/holds?dep=ID&ref=ID       does the CIND hold (capture ids, or
+                                   dep_code/dep_v1/dep_v2 + ref_* string
+                                   captures)
+  /query/referenced?dep=ID[&limit] what the dependent references + support
+  /query/topk?k=N                  the k CINDs with the largest support
+
+and /status gains a "serving_index" struct: loaded vs on-disk generation,
+pending-swap verdict, and the loaded-generation certificate chain.
+
 Everything is read-only and served from in-process state (the registry,
 the flight recorder, the heartbeat directory) — the handler threads never
 touch device state, so a scrape cannot perturb the run.  The server binds
@@ -34,6 +46,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import flightrec, heartbeat, metrics
@@ -50,6 +63,7 @@ _PROGRESS_KEYS = ("run_stage", "run_pass", "n_pair_passes", "planned_caps",
 _SERVER: ThreadingHTTPServer | None = None
 _THREAD: threading.Thread | None = None
 _OBS_DIR: str | None = None
+_QUERY_SERVICE = None  # runtime.serving.IndexService when a server arms it
 
 
 def env_port() -> int | None:
@@ -77,6 +91,13 @@ def set_obs_dir(directory: str | None) -> None:
     when tracing and the console are both armed)."""
     global _OBS_DIR
     _OBS_DIR = directory
+
+
+def set_query_service(service) -> None:
+    """Arm the /query/* routes with a runtime.serving.IndexService (the
+    serving process wires this; None disarms)."""
+    global _QUERY_SERVICE
+    _QUERY_SERVICE = service
 
 
 def start(bind_port: int = 0, host: str = DEFAULT_HOST,
@@ -114,6 +135,7 @@ def stop() -> None:
         _THREAD.join(timeout=5.0)
         _THREAD = None
     set_obs_dir(None)
+    set_query_service(None)
 
 
 # ---------------------------------------------------------------------------
@@ -148,7 +170,59 @@ def status_payload() -> dict:
     out = {"serving": True, "pid": os.getpid(), "obs_dir": _OBS_DIR}
     if _OBS_DIR:
         out["heartbeat"] = heartbeat.assess(_OBS_DIR)
+    if _QUERY_SERVICE is not None:
+        out["serving_index"] = _QUERY_SERVICE.status()
     return out
+
+
+def _capture_arg(q: dict, role: str):
+    """A capture from query params: `role`=ID (capture id) or the string
+    triple `role`_code/`role`_v1/`role`_v2.  Raises ValueError when absent
+    or malformed."""
+    if role in q:
+        return int(q[role][0])
+    code_key = f"{role}_code"
+    if code_key not in q:
+        raise ValueError(f"missing {role} (give {role}=<capture id> or "
+                         f"{role}_code/{role}_v1/{role}_v2)")
+    v1 = q.get(f"{role}_v1", [None])[0]
+    v2 = q.get(f"{role}_v2", [None])[0]
+    return (int(q[code_key][0]), v1, v2)
+
+
+def query_holds_payload(query: str) -> tuple[dict, int]:
+    if _QUERY_SERVICE is None:
+        return {"error": "no query service armed"}, 503
+    q = urllib.parse.parse_qs(query)
+    try:
+        dep = _capture_arg(q, "dep")
+        ref = _capture_arg(q, "ref")
+    except ValueError as e:
+        return {"error": str(e)}, 400
+    return _QUERY_SERVICE.query_holds(dep, ref), 200
+
+
+def query_referenced_payload(query: str) -> tuple[dict, int]:
+    if _QUERY_SERVICE is None:
+        return {"error": "no query service armed"}, 503
+    q = urllib.parse.parse_qs(query)
+    try:
+        dep = _capture_arg(q, "dep")
+        limit = int(q["limit"][0]) if "limit" in q else None
+    except ValueError as e:
+        return {"error": str(e)}, 400
+    return _QUERY_SERVICE.query_referenced(dep, limit=limit), 200
+
+
+def query_topk_payload(query: str) -> tuple[dict, int]:
+    if _QUERY_SERVICE is None:
+        return {"error": "no query service armed"}, 503
+    q = urllib.parse.parse_qs(query)
+    try:
+        k = int(q.get("k", ["10"])[0])
+    except ValueError as e:
+        return {"error": str(e)}, 400
+    return _QUERY_SERVICE.query_topk(k), 200
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -172,7 +246,9 @@ class _Handler(BaseHTTPRequestHandler):
                    "application/json", code)
 
     def do_GET(self):  # noqa: N802 (http.server API)
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        parts = self.path.split("?", 1)
+        path = parts[0].rstrip("/") or "/"
+        query = parts[1] if len(parts) > 1 else ""
         try:
             if path == "/metrics":
                 self._send(metrics.registry().prometheus_text(),
@@ -188,10 +264,19 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/flightrec":
                 self._send_json({"enabled": flightrec.enabled(),
                                  "events": flightrec.snapshot()})
+            elif path == "/query/holds":
+                self._send_json(*query_holds_payload(query))
+            elif path == "/query/referenced":
+                self._send_json(*query_referenced_payload(query))
+            elif path == "/query/topk":
+                self._send_json(*query_topk_payload(query))
             elif path == "/":
-                self._send_json({"endpoints": ["/metrics", "/status",
-                                               "/progress", "/datastats",
-                                               "/integrity", "/flightrec"]})
+                endpoints = ["/metrics", "/status", "/progress",
+                             "/datastats", "/integrity", "/flightrec"]
+                if _QUERY_SERVICE is not None:
+                    endpoints += ["/query/holds", "/query/referenced",
+                                  "/query/topk"]
+                self._send_json({"endpoints": endpoints})
             else:
                 self._send_json({"error": f"unknown path {path}"}, code=404)
         except Exception as e:  # a bad scrape must never kill the thread

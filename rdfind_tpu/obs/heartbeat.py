@@ -104,7 +104,11 @@ def assess(directory: str, stale_s: float = DEFAULT_STALE_S,
     final-but-old host must never flip a still-working peer's run to
     "wedged"); `done` = every host wrote its final beat.  Hosts whose
     clocks run ahead of the assessor's produce negative ages, which are
-    trivially fresh.
+    trivially fresh.  Hosts beating ``mode="serve"`` are exempt from the
+    wedge check: a serving process is a long-lived idle loop with no pass
+    progress by design, so an old-but-not-final serve beat means "idle",
+    never "stuck" (its staleness is the watcher's SERVING-STALE concern,
+    not a liveness verdict).
     """
     beats = read_all(directory)
     if not beats:
@@ -114,7 +118,8 @@ def assess(directory: str, stale_s: float = DEFAULT_STALE_S,
     if all(b.get("final") for b in beats.values()):
         state = "done"
     elif any(age > stale_s for h, age in ages.items()
-             if not beats[h].get("final")):
+             if not beats[h].get("final")
+             and beats[h].get("mode") != "serve"):
         state = "wedged"
     else:
         state = "alive"

@@ -147,6 +147,13 @@ METRIC_SPECS = (
      ("detail", "delta", "d1pct", "frac_passes_rerun"), "lower"),
     ("delta_wall_1pct_s",
      ("detail", "delta", "d1pct", "delta_wall_s"), "lower"),
+    # Serving rows (bench_serve.py): the query plane's hot path gates like
+    # a kernel — single-thread holds() QPS over the mmap'd index, the
+    # O(header) open time (a regression here means something started
+    # materializing sections at open), and the holds() tail latency.
+    ("serve_qps", ("detail", "serve", "holds_qps"), "higher"),
+    ("serve_open_ms", ("detail", "serve", "open_ms"), "lower"),
+    ("serve_p99_us", ("detail", "serve", "holds_p99_us"), "lower"),
 )
 _DIRECTIONS = {name: d for name, _, d in METRIC_SPECS}
 

@@ -73,7 +73,7 @@ from ..dictionary import Dictionary
 from ..io import native, ntriples, prefixes, reader
 from ..obs import integrity, metrics, tracer
 from ..ops import hashing
-from . import checkpoint
+from . import checkpoint, serving
 
 DELTA_FORMAT = 1
 
@@ -1033,6 +1033,14 @@ def run_delta(cfg, phases, counters: dict, stats: dict):
                     merged_full)
     phases.run("delta-state", save_state)
     metrics.struct_update(stats, "delta", new_generation=generation + 1)
+    # Commit the servable generation next to the advanced bundle: a serving
+    # process polling the dir digest-verifies it, checks the certificate
+    # chain (base_output_digest == the generation it loaded), and hot-swaps.
+    phases.run("serve-index", lambda: serving.emit_index(
+        [cfg.delta_base], dictionary, table, generation=generation + 1,
+        base_output_digest=meta["output_digest"],
+        strategy=cfg.traversal_strategy, min_support=cfg.min_support,
+        stats=stats))
 
     counters["cind-counter"] = len(table)
     counters.update({f"stat-{k}": v for k, v in stats.items()})

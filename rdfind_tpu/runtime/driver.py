@@ -26,7 +26,7 @@ from ..models import allatonce, approximate, late_bb, sharded, small_to_large
 from ..obs import memory as obs_memory
 from ..obs import console, flightrec, integrity, metrics, report, tracer
 from ..parallel.mesh import make_mesh
-from . import checkpoint
+from . import checkpoint, serving
 
 
 @dataclasses.dataclass
@@ -1066,6 +1066,14 @@ def _run(cfg: Config) -> RunResult:
         from . import delta
         phases.run("delta-state", lambda: delta.write_base_bundle(
             cfg, ids, dictionary, table, stats, phases.timings))
+    if _is_primary() and (cfg.delta_state or serving.env_index_dir()):
+        # The servable artifact: generation-0 mmap index next to the bundle
+        # (and/or into RDFIND_SERVE_INDEX) for runtime/serving readers.
+        phases.run("serve-index", lambda: serving.emit_index(
+            [cfg.delta_state] if cfg.delta_state else [],
+            dictionary, table, generation=0, base_output_digest=None,
+            strategy=cfg.traversal_strategy, min_support=cfg.min_support,
+            stats=stats))
     counters.update({f"stat-{k}": v for k, v in stats.items()})
     _emit_sinks(cfg, phases, counters, table, dictionary, stats, ids)
 

@@ -1,0 +1,225 @@
+"""Serving parity gate: index answers bit-consistent with discovery output.
+
+CPU-proxy workload; three checks:
+
+  1. answer parity, all four traversal strategies — a run that persists a
+     bundle (--delta-state) commits a generation-0 index next to it; every
+     CIND in the run's table must answer holds=true (and referenced() must
+     return exactly the table's refset), and sampled non-CIND pairs must
+     answer false — oracle-checked against the in-memory table, through the
+     STRING capture path (so dictionary/interner parity is covered, not
+     just id plumbing);
+  2. hot-swap differential — an IndexService serving generation 0 polls
+     after a --delta run advances the bundle; the swap must verify + chain
+     (history gen 0 -> 1) and the swapped answers must be identical to a
+     from-scratch index built by a clean run on the updated dataset;
+  3. integrity wiring — a flipped byte in a committed index is refused by
+     the service with the section named, and the old generation keeps
+     serving.
+
+scripts/verify.sh runs this before the bench gate; VERIFY_SKIP_SERVE=1
+opts out.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["RDFIND_BACKOFF_BASE_MS"] = "1"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _capture_strings(table, dictionary, row):
+    """(dep, ref) string captures of table row `row`."""
+    def dec(v):
+        return None if int(v) < 0 else dictionary.value(int(v))
+    dep = (int(table.dep_code[row]), dec(table.dep_v1[row]),
+           dec(table.dep_v2[row]))
+    ref = (int(table.ref_code[row]), dec(table.ref_v1[row]),
+           dec(table.ref_v2[row]))
+    return dep, ref
+
+
+def _answers(reader):
+    """The index's full CIND answer set as id triples (for differentials
+    the two indexes share a value space by construction: same sorted
+    dictionary -> same ranks)."""
+    return set(reader.iter_cinds())
+
+
+def main() -> int:
+    from rdfind_tpu.runtime import driver, serving
+    from rdfind_tpu.utils import synth
+
+    failures = []
+    support = 3
+    triples = synth.generate_triples(900, seed=3)
+    ins, dels = synth.grow_delta_batches(triples, 0.01, seed=4)
+
+    with tempfile.TemporaryDirectory() as root:
+        paths = {k: os.path.join(root, f"{k}.nt")
+                 for k in ("base", "ins", "del", "upd")}
+        synth.write_nt(paths["base"], triples)
+        synth.write_nt(paths["ins"], ins)
+        synth.write_nt(paths["del"], dels)
+        synth.write_nt(paths["upd"], synth.apply_delta(triples, ins, dels))
+        rng = np.random.default_rng(11)
+
+        # --- 1. answer parity, all four strategies -------------------------
+        for strat in (0, 1, 2, 3):
+            bundle = os.path.join(root, f"bundle{strat}")
+            res = driver.run(driver.Config(
+                input_paths=[paths["base"]], min_support=support,
+                traversal_strategy=strat, delta_state=bundle))
+            try:
+                reader = serving.IndexReader(serving.index_path(bundle))
+            except serving.IndexMiss as e:
+                failures.append(f"strategy {strat}: no index emitted ({e})")
+                continue
+            v = reader.verify()
+            if not v["ok"]:
+                failures.append(f"strategy {strat}: fresh index fails "
+                                f"verification: {v['mismatches']}")
+            table, dic = res.table, res.dictionary
+            if not len(table):
+                failures.append(f"strategy {strat}: empty table "
+                                "(gate is vacuous)")
+            truth = set()
+            for row in range(len(table)):
+                dep, ref = _capture_strings(table, dic, row)
+                truth.add((dep, ref))
+                if not reader.holds(dep, ref):
+                    failures.append(f"strategy {strat}: CIND row {row} "
+                                    f"{dep} < {ref} answers holds=false")
+                    break
+                want_sup = int(table.support[row])
+                if reader.support(dep) != want_sup:
+                    failures.append(
+                        f"strategy {strat}: support({dep}) = "
+                        f"{reader.support(dep)} != {want_sup}")
+                    break
+            # referenced() completeness for one sampled dependent.
+            row = int(rng.integers(0, len(table)))
+            dep, _ = _capture_strings(table, dic, row)
+            got_refs = set(reader.referenced(dep))
+            want_refs = {r for d, r in truth if d == dep}
+            if got_refs != want_refs:
+                failures.append(
+                    f"strategy {strat}: referenced({dep}) returned "
+                    f"{len(got_refs)} captures, table says "
+                    f"{len(want_refs)}")
+            # top-k ordering: nonincreasing support, first == max.
+            tk = reader.topk(min(10, reader.n_cinds), decode=False)
+            sups = [s for _, _, s in tk]
+            if sups != sorted(sups, reverse=True) or (
+                    sups and sups[0] != int(np.max(table.support))):
+                failures.append(f"strategy {strat}: topk support order "
+                                f"broken: {sups}")
+            # Sampled non-CIND pairs must answer false.
+            deps = sorted({d for d, _ in truth})
+            refs = sorted({r for _, r in truth})
+            checked = 0
+            for _ in range(500):
+                d = deps[int(rng.integers(0, len(deps)))]
+                r = refs[int(rng.integers(0, len(refs)))]
+                if (d, r) in truth or d == r:
+                    continue
+                checked += 1
+                if reader.holds(d, r):
+                    failures.append(f"strategy {strat}: non-CIND pair "
+                                    f"{d} < {r} answers holds=true")
+                    break
+            if checked == 0:
+                failures.append(f"strategy {strat}: no negative pairs "
+                                "sampled (gate is vacuous)")
+            reader.close()
+
+        # --- 2. delta hot-swap differential --------------------------------
+        bundle = os.path.join(root, "bundle0")  # strategy-0 gen-0 bundle
+        svc = serving.IndexService(bundle)
+        v0 = svc.poll()
+        if v0.get("action") != "swapped" or svc.generation != 0:
+            failures.append(f"service did not load generation 0: {v0}")
+        with svc.acquire() as r:
+            gen0_answers = _answers(r) if r else set()
+        res_delta = driver.run(driver.Config(
+            input_paths=[paths["ins"]], delete_paths=[paths["del"]],
+            min_support=support, traversal_strategy=0, delta_base=bundle))
+        v1 = svc.poll()
+        if v1.get("action") != "swapped" or svc.generation != 1:
+            failures.append(f"hot swap to generation 1 failed: {v1}, "
+                            f"pending={svc.pending}")
+        if [c["generation"] for c in svc.chain] != [0, 1]:
+            failures.append(f"swap history chain wrong: {svc.chain}")
+
+        scratch_dir = os.path.join(root, "scratch_bundle")
+        driver.run(driver.Config(
+            input_paths=[paths["upd"]], min_support=support,
+            traversal_strategy=0, delta_state=scratch_dir))
+        scratch = serving.IndexReader(serving.index_path(scratch_dir))
+        with svc.acquire() as r:
+            swapped_answers = _answers(r)
+            swapped_digest = r.output_digest
+        scratch_answers = _answers(scratch)
+        if swapped_answers != scratch_answers:
+            failures.append(
+                f"hot-swapped answers differ from from-scratch index: "
+                f"{len(swapped_answers ^ scratch_answers)} rows")
+        if swapped_digest != scratch.output_digest:
+            failures.append(
+                f"swapped output digest {swapped_digest} != from-scratch "
+                f"{scratch.output_digest}")
+        if swapped_answers == gen0_answers:
+            failures.append("generation 1 answers identical to generation "
+                            "0 — the differential is vacuous")
+        scratch.close()
+
+        # --- 3. corrupted candidate refused, old generation kept -----------
+        path = serving.index_path(bundle)
+        blob = bytearray(open(path, "rb").read())
+        meta_reader = serving.IndexReader(path)
+        sec = meta_reader.meta["sections"][0]
+        meta_reader.close()
+        blob[int(sec["offset"])] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(blob)
+        os.utime(path, ns=(1, 1))  # force the stat key to change
+        v2 = svc.poll()
+        if v2.get("action") != "refused" or \
+                v2.get("reason") != "section-digest-mismatch" or \
+                sec["name"] not in v2.get("sections", []):
+            failures.append(f"corrupt index not refused by name: {v2}")
+        if svc.generation != 1:
+            failures.append(f"service abandoned generation 1 after a "
+                            f"corrupt candidate (now {svc.generation})")
+        with svc.acquire() as r:
+            if r is None or _answers(r) != swapped_answers:
+                failures.append("old generation stopped answering after a "
+                                "refused swap")
+        svc.close()
+        del res_delta
+
+    if failures:
+        for f in failures:
+            print(f"serve_parity: {f}", file=sys.stderr)
+        return 1
+    print("serve_parity: OK — index answers match discovery output for "
+          "strategies 0-3 (holds/referenced/support/topk, sampled "
+          "negatives false), delta gen 0 -> 1 hot-swap chained and "
+          "bit-identical to a from-scratch index, corrupt candidate "
+          "refused by section name with the old generation still serving")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -124,6 +124,18 @@ elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/watchdog_parity.py; th
     exit 1
 fi
 
+echo "== serve parity (index answers vs discovery output + hot swap) =="
+# The mmap'd CIND index must answer bit-consistently with the run that
+# wrote it (all four strategies), and a delta-committed generation must
+# hot-swap with answers identical to a from-scratch index (corrupt
+# candidates refused by section name).  VERIFY_SKIP_SERVE=1 opts out.
+if [ "${VERIFY_SKIP_SERVE:-0}" = "1" ]; then
+    echo "verify: serve parity skipped (VERIFY_SKIP_SERVE=1)"
+elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/serve_parity.py; then
+    echo "verify: serve parity FAILED" >&2
+    exit 1
+fi
+
 if [ "${VERIFY_SKIP_BENCH:-0}" = "1" ]; then
     echo "verify: tier-1 green; bench + sentinel skipped (VERIFY_SKIP_BENCH=1)"
     exit 0
@@ -153,6 +165,20 @@ if ! BENCH_BACKEND=cpu JAX_PLATFORMS=cpu \
      BENCH_HISTORY="$hist" \
      timeout -k 10 1800 python bench_delta.py > /tmp/_verify_bench_delta.json; then
     echo "verify: tiny delta bench FAILED (see /tmp/_verify_bench_delta.json)" >&2
+    exit 1
+fi
+if ! python -m rdfind_tpu.obs.sentinel --check --history "$hist"; then
+    exit 1
+fi
+
+echo "== serve bench -> BENCH_HISTORY -> regression sentinel =="
+# Query-plane rows (serve_qps / serve_open_ms / serve_p99_us): the mmap'd
+# index's open must stay O(header) and holds() must stay >= the QPS floor;
+# regressions gate like kernel regressions.
+if ! BENCH_BACKEND=cpu JAX_PLATFORMS=cpu \
+     BENCH_HISTORY="$hist" \
+     timeout -k 10 900 python bench_serve.py > /tmp/_verify_bench_serve.json; then
+    echo "verify: serve bench FAILED (see /tmp/_verify_bench_serve.json)" >&2
     exit 1
 fi
 python -m rdfind_tpu.obs.sentinel --check --history "$hist"

@@ -348,6 +348,74 @@ def test_heartbeat_final_but_stale_stays_done(tmp_path):
     assert heartbeat.assess(d, stale_s=1, now=ts1 + 3600)["state"] == "wedged"
 
 
+def test_heartbeat_serve_mode_never_wedges(tmp_path):
+    """Satellite: a long-lived idle server (mode="serve") is exempt from
+    the wedge check — it has no pass progress by design, so an arbitrarily
+    old serve beat stays 'alive'; a stale WORKER next to it still wedges
+    the directory (the exemption is per-host, not per-directory)."""
+    d = str(tmp_path)
+    heartbeat.write(d, {"stage": "serve", "mode": "serve",
+                        "generation": 2}, host_index=0)
+    ts = heartbeat.read(d, 0)["ts"]
+    verdict = heartbeat.assess(d, stale_s=60, now=ts + 7 * 24 * 3600)
+    assert verdict["state"] == "alive"
+    assert verdict["hosts"][0]["mode"] == "serve"
+    # A stale non-serve peer is still a wedge.
+    heartbeat.write(d, {"stage": "discover", "pass": 1}, host_index=1)
+    ts1 = heartbeat.read(d, 1)["ts"]
+    assert heartbeat.assess(d, stale_s=60,
+                            now=ts1 + 3600)["state"] == "wedged"
+    # ...and a final serve beat counts toward 'done' like any other.
+    heartbeat.Heartbeat(d, host_index=1).beat({"stage": "discover"},
+                                              final=True)
+    heartbeat.Heartbeat(d, host_index=0).beat(
+        {"stage": "serve", "mode": "serve"}, final=True)
+    assert heartbeat.assess(d, stale_s=60)["state"] == "done"
+
+
+def test_tpu_watch_status_serving_stale(tmp_path):
+    """Satellite: a serve heartbeat whose bundle dir holds a newer
+    generation than the loaded index is a SERVING-STALE verdict — surfaced
+    in prose and --json without changing the exit-code ladder (serving
+    stale is exit 0: the server is alive and answering, just behind)."""
+    import subprocess
+    import sys
+
+    d = str(tmp_path)
+    heartbeat.write(d, {
+        "stage": "serve", "mode": "serve", "generation": 1,
+        "bundle_generation": 2,
+        "pending_swap": {"reason": "section-digest-mismatch",
+                         "sections": ["ref_ids"]}}, host_index=0)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "SERVING-STALE" in r.stdout
+    assert "[serve, gen 1]" in r.stdout
+    assert "section-digest-mismatch" in r.stdout
+    # An idle-but-old server alone must not read wedged (the assess
+    # exemption end-to-end through the CLI).
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d,
+         "--stale-s", "0", "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    payload = json.loads(r.stdout)
+    assert payload["state"] == "alive"
+    assert payload["serving_stale"] is True
+    # An up-to-date server is not stale.
+    heartbeat.write(d, {"stage": "serve", "mode": "serve", "generation": 2,
+                        "bundle_generation": 2}, host_index=0)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tpu_watch.py"), "--status", d,
+         "--json"],
+        capture_output=True, text=True, timeout=60)
+    payload = json.loads(r.stdout)
+    assert payload["serving_stale"] is False and r.returncode == 0
+
+
 def test_tpu_watch_status_degrading(tmp_path):
     """Satellite: --status flags 'degrading' (forecast advisory riding the
     heartbeat) distinct from 'wedged', without changing the exit code."""

@@ -1,0 +1,505 @@
+"""Zero-copy CIND index + query serving (runtime/serving, ISSUE 19).
+
+Covers the on-disk format roundtrip against an in-memory oracle, the
+corruption ladder (flipped byte per section -> named mismatch; truncation
+and torn commits -> clean miss), the generation swapper's admission gates
+(integrity, monotonicity, certificate chain), zero-dropped-query hot swap
+under concurrent load, the console's query payloads (no socket needed),
+and the driver/delta emit hooks chaining generation 0 -> 1."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from rdfind_tpu import conditions as cc
+from rdfind_tpu.data import NO_VALUE, CindTable
+from rdfind_tpu.obs import console
+from rdfind_tpu.runtime import serving
+from rdfind_tpu.utils import synth
+
+CODES = cc.ALL_VALID_CAPTURE_CODES[:3]
+
+
+def _workload(n_deps=40, refs_per_dep=5, seed=7):
+    """(values, table, truth): a synthetic CIND set with distinct dep/ref
+    values; truth = {(dep_triple, ref_triple): support} over interned ids."""
+    rng = np.random.default_rng(seed)
+    dep_vals = [f"http://ex.org/dep/{i:05d}" for i in range(n_deps)]
+    ref_vals = [f"http://ex.org/ref/{i:05d}"
+                for i in range(n_deps * refs_per_dep)]
+    values = sorted(dep_vals + ref_vals)
+    vid = {v: i for i, v in enumerate(values)}
+    rows, truth = [], {}
+    for d in range(n_deps):
+        sup = int(rng.integers(2, 500))
+        dep = (CODES[d % len(CODES)], vid[dep_vals[d]], NO_VALUE)
+        for r in range(refs_per_dep):
+            rv = ref_vals[d * refs_per_dep + r]
+            ref = (CODES[(d + r) % len(CODES)], vid[rv], NO_VALUE)
+            rows.append((*dep, *ref, sup))
+            truth[(dep, ref)] = sup
+    return values, CindTable.from_rows(rows), truth
+
+
+def _write(tmp_path, values=None, table=None, generation=0,
+           output_digest="d0", base_output_digest=None):
+    if values is None:
+        values, table, _ = _workload()
+    return serving.write_index(
+        str(tmp_path), values, table, generation=generation,
+        output_digest=output_digest, base_output_digest=base_output_digest)
+
+
+# ---------------------------------------------------------------------------
+# Format roundtrip vs oracle.
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_matches_oracle(tmp_path):
+    values, table, truth = _workload()
+    path = _write(tmp_path, values, table)
+    r = serving.IndexReader(path)
+    assert r.generation == 0 and r.n_cinds == len(table)
+    assert r.verify() == {"ok": True, "mismatches": []}
+
+    # Every planted CIND answers holds=true through the STRING path; the
+    # string captures resolve to the same ids the table carries.
+    for (dep, ref), sup in truth.items():
+        dep_s = (dep[0], values[dep[1]], None)
+        ref_s = (ref[0], values[ref[1]], None)
+        assert r.holds(dep_s, ref_s)
+        assert r.support(dep_s) == sup
+    # Sampled non-pairs answer false; unknown values answer false, not KeyError.
+    deps = sorted({d for d, _ in truth})
+    refs = sorted({f for _, f in truth})
+    rng = np.random.default_rng(3)
+    neg = 0
+    for _ in range(200):
+        d = deps[int(rng.integers(0, len(deps)))]
+        f = refs[int(rng.integers(0, len(refs)))]
+        if (d, f) in truth:
+            continue
+        neg += 1
+        assert not r.holds((d[0], values[d[1]], None),
+                           (f[0], values[f[1]], None))
+    assert neg > 50
+    assert not r.holds((CODES[0], "http://nowhere/x", None),
+                       (CODES[0], values[0], None))
+    assert r.value_id("http://nowhere/x") == -1
+
+    # referenced() returns exactly the planted refset, decoded.
+    dep = deps[0]
+    got = set(r.referenced((dep[0], values[dep[1]], None)))
+    want = {(f[0], values[f[1]], None) for d, f in truth if d == dep}
+    assert got == want
+
+    # top-k: support nonincreasing, first == global max, k > n truncates.
+    tk = r.topk(10, decode=False)
+    sups = [s for _, _, s in tk]
+    assert sups == sorted(sups, reverse=True)
+    assert sups[0] == int(np.max(table.support))
+    assert len(r.topk(10 ** 6)) == len(table)
+    # iter_cinds covers the whole table.
+    assert len(list(r.iter_cinds())) == len(table)
+    r.close()
+
+
+def test_value_ids_are_sorted_ranks(tmp_path):
+    """The index's value ids ARE the dictionary's sorted ranks — one id
+    space across ingest, output, and serving (the interner's law)."""
+    values, table, _ = _workload(n_deps=8)
+    r = serving.IndexReader(_write(tmp_path, values, table))
+    for i, v in enumerate(values):
+        assert r.value_id(v) == i
+        assert r.value(i) == v
+    r.close()
+
+
+def test_common_prefix_dictionary_lookup(tmp_path):
+    """URI-shaped values share >8-byte prefixes, collapsing the prefix8
+    narrowing — lookup must stay logarithmic-correct (full-byte bisect),
+    including around the run's edges."""
+    values = sorted(f"http://example.org/entity/{i:06d}" for i in range(500))
+    vid = {v: i for i, v in enumerate(values)}
+    rows = [(CODES[0], vid[values[0]], NO_VALUE,
+             CODES[1], vid[values[-1]], NO_VALUE, 9)]
+    r = serving.IndexReader(
+        _write(tmp_path, values, CindTable.from_rows(rows)))
+    assert all(r.value_id(v) == i for i, v in enumerate(values))
+    assert r.value_id("http://example.org/entity/999999") == -1
+    assert r.value_id("http://example.org/") == -1
+    r.close()
+
+
+def test_cache_knob(tmp_path, monkeypatch):
+    values, table, truth = _workload(n_deps=6)
+    (dep, ref), _ = next(iter(truth.items()))
+    dep_s = (dep[0], values[dep[1]], None)
+    ref_s = (ref[0], values[ref[1]], None)
+    path = _write(tmp_path, values, table)
+    monkeypatch.setenv("RDFIND_SERVE_CACHE", "0")
+    r = serving.IndexReader(path)
+    assert r._vcache is None and r.holds(dep_s, ref_s)
+    r.close()
+    monkeypatch.setenv("RDFIND_SERVE_CACHE", "1")
+    r = serving.IndexReader(path)
+    assert r.holds(dep_s, ref_s) and r.holds(dep_s, ref_s)  # memo path
+    assert r._ccache
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# Corruption ladder: every section names its own mismatch; torn/truncated
+# files are clean misses.
+# ---------------------------------------------------------------------------
+
+
+def test_corruption_ladder_names_every_section(tmp_path):
+    path = _write(tmp_path)
+    clean = open(path, "rb").read()
+    meta_reader = serving.IndexReader(path)
+    sections = [dict(s) for s in meta_reader.meta["sections"]]
+    meta_reader.close()
+    assert [s["name"] for s in sections] == list(serving._SECTIONS)
+    for sec in sections:
+        if not sec["nbytes"]:
+            continue
+        blob = bytearray(clean)
+        blob[sec["offset"] + sec["nbytes"] // 2] ^= 0x40
+        with open(path, "wb") as f:
+            f.write(blob)
+        r = serving.IndexReader(path)  # open is O(header): no digest read
+        v = r.verify()
+        assert v["ok"] is False and v["mismatches"] == [sec["name"]], \
+            f"flip in {sec['name']} blamed {v['mismatches']}"
+        r.close()
+    with open(path, "wb") as f:
+        f.write(clean)
+    assert serving.IndexReader(path).verify()["ok"]
+
+
+def test_truncation_and_torn_writes_are_clean_misses(tmp_path):
+    path = _write(tmp_path)
+    clean = open(path, "rb").read()
+    # Truncation at any boundary: miss, never a partial answer.
+    for cut in (0, 3, 15, 200, len(clean) - 1):
+        with open(path, "wb") as f:
+            f.write(clean[:cut])
+        with pytest.raises(serving.IndexMiss):
+            serving.IndexReader(path)
+        assert serving.peek_generation(path) is None
+    # A torn commit (magic never written — the writer's pre-rename state).
+    with open(path, "wb") as f:
+        f.write(b"\0\0\0\0" + clean[4:])
+    with pytest.raises(serving.IndexMiss):
+        serving.IndexReader(path)
+    # Unknown format version: miss, not a misparse.
+    with open(path, "wb") as f:
+        f.write(clean[:4] + (99).to_bytes(4, "little") + clean[8:])
+    with pytest.raises(serving.IndexMiss):
+        serving.IndexReader(path)
+    # Absent file.
+    os.unlink(path)
+    with pytest.raises(serving.IndexMiss):
+        serving.IndexReader(path)
+    assert serving.peek_generation(path) is None
+
+
+# ---------------------------------------------------------------------------
+# The generation swapper's admission gates.
+# ---------------------------------------------------------------------------
+
+
+def _touch(path, ns):
+    os.utime(path, ns=(ns, ns))
+
+
+def test_service_refuses_corrupt_swap_keeps_serving(tmp_path):
+    values, table, truth = _workload()
+    path = _write(tmp_path, values, table, generation=0, output_digest="g0")
+    svc = serving.IndexService(str(tmp_path))
+    assert svc.poll()["action"] == "swapped" and svc.generation == 0
+    assert svc.poll()["action"] == "none"  # unchanged stat key
+
+    # Corrupt candidate: refused BY NAME, old generation keeps answering.
+    clean = open(path, "rb").read()
+    r = serving.IndexReader(path)
+    sec = r.meta["sections"][-1]
+    r.close()
+    blob = bytearray(clean)
+    blob[sec["offset"]] ^= 0x01
+    with open(path, "wb") as f:
+        f.write(blob)
+    _touch(path, 10_000)
+    stats = {}
+    v = svc.poll(stats)
+    assert v["action"] == "refused"
+    assert v["reason"] == "section-digest-mismatch"
+    assert v["sections"] == [sec["name"]]
+    assert svc.generation == 0 and svc.pending["reason"] == \
+        "section-digest-mismatch"
+    assert stats["integrity_events"][0]["stage"] == f"index-{sec['name']}"
+    assert stats["integrity_events"][0]["site"] == "serve-swap"
+    with svc.acquire() as reader:
+        assert reader is not None and reader.generation == 0
+    assert svc.status()["stale"] is False  # corrupt candidate has no gen
+
+    # A clean rewrite at a higher generation is admitted.
+    serving.write_index(str(tmp_path), values, table, generation=1,
+                        output_digest="g1", base_output_digest="g0")
+    v = svc.poll()
+    assert v == {"action": "swapped", "generation": 1}
+    assert svc.pending is None and svc.swaps == 2
+    svc.close()
+
+
+def test_service_chain_and_regression_gates(tmp_path, monkeypatch):
+    values, table, _ = _workload(n_deps=6)
+    d = str(tmp_path)
+    _write(tmp_path, values, table, generation=1, output_digest="g1",
+           base_output_digest="g0")
+    svc = serving.IndexService(d)
+    assert svc.poll()["action"] == "swapped"
+
+    # Generation regression: refused even with a valid chain field.
+    path = _write(tmp_path, values, table, generation=0,
+                  output_digest="g0")
+    _touch(path, 20_000)
+    v = svc.poll()
+    assert v["action"] == "refused" and v["reason"] == \
+        "generation-regressed"
+    assert svc.generation == 1
+
+    # Chain break: generation advances but base_output_digest does not
+    # point at the loaded cert.
+    path = _write(tmp_path, values, table, generation=2,
+                  output_digest="g2", base_output_digest="not-g1")
+    _touch(path, 30_000)
+    v = svc.poll()
+    assert v["action"] == "refused" and v["reason"] == "chain-broken"
+    assert svc.generation == 1
+    # Stale verdict: the bundle dir moved on, the server did not.
+    st = svc.status()
+    assert st["stale"] is True and st["bundle_generation"] == 2
+    svc.close()
+
+    # RDFIND_SERVE_CHAIN=0 admits the same candidate.
+    monkeypatch.setenv("RDFIND_SERVE_CHAIN", "0")
+    svc = serving.IndexService(d)
+    assert svc.poll()["action"] == "swapped"  # loads gen 2 directly
+    assert svc.generation == 2
+    svc.close()
+
+
+def test_service_verify_knob_and_tmp_files_ignored(tmp_path, monkeypatch):
+    values, table, _ = _workload(n_deps=6)
+    path = _write(tmp_path, values, table)
+    # A stray writer tmp (crashed producer) next to the index is inert.
+    with open(path + f".tmp.{os.getpid()}", "wb") as f:
+        f.write(b"\0" * 128)
+    monkeypatch.setenv("RDFIND_SERVE_VERIFY", "0")
+    svc = serving.IndexService(str(tmp_path))
+    assert svc._verify is False
+    # With verification off a flipped byte is admitted (the operator's
+    # explicit trade) — the knob is honored end-to-end.
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0x01
+    with open(path, "wb") as f:
+        f.write(blob)
+    assert svc.poll()["action"] == "swapped"
+    svc.close()
+
+
+def test_service_no_index_is_miss_not_error(tmp_path):
+    svc = serving.IndexService(str(tmp_path))
+    assert svc.poll()["action"] == "miss"
+    with svc.acquire() as r:
+        assert r is None
+    assert svc.query_holds(0, 1) == {"error": "no index loaded"}
+    st = svc.status()
+    assert st["generation"] is None and st["bundle_generation"] is None
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Hot swap under concurrent load: zero errors, monotonic generation, old
+# mapping closed only after the last in-flight reference.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_concurrent_queries_during_swaps(tmp_path):
+    values, table, truth = _workload()
+    (dep, ref), _ = next(iter(sorted(truth.items())))
+    dep_s = (dep[0], values[dep[1]], None)
+    ref_s = (ref[0], values[ref[1]], None)
+    _write(tmp_path, values, table, generation=0, output_digest="g0")
+    svc = serving.IndexService(str(tmp_path))
+    assert svc.poll()["action"] == "swapped"
+
+    stop = threading.Event()
+    errors, gens = [], [[] for _ in range(4)]
+
+    def reader_thread(i):
+        try:
+            while not stop.is_set():
+                with svc.acquire() as r:
+                    assert r is not None
+                    assert r.holds(dep_s, ref_s)
+                    assert len(r.referenced(dep_s)) == 5
+                    gens[i].append(r.generation)
+        except Exception as e:  # noqa: BLE001 — the assertion IS the test
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=reader_thread, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    digest = "g0"
+    for gen in range(1, 6):
+        new_digest = f"g{gen}"
+        path = serving.write_index(
+            str(tmp_path), values, table, generation=gen,
+            output_digest=new_digest, base_output_digest=digest)
+        _touch(path, gen * 1_000_000)
+        v = svc.poll()
+        assert v == {"action": "swapped", "generation": gen}
+        digest = new_digest
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    for seq in gens:
+        assert seq, "a reader thread never completed a query"
+        assert seq == sorted(seq), "generation went backward mid-thread"
+    assert [c["generation"] for c in svc.chain] == list(range(6))
+    assert svc.generation == 5
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Console query plane (payload builders — no socket).
+# ---------------------------------------------------------------------------
+
+
+def test_console_query_payloads(tmp_path):
+    values, table, truth = _workload(n_deps=6)
+    (dep, ref), sup = next(iter(sorted(truth.items())))
+    _write(tmp_path, values, table)
+    svc = serving.IndexService(str(tmp_path))
+    svc.poll()
+    console.set_query_service(svc)
+    try:
+        q = (f"dep_code={dep[0]}&dep_v1={values[dep[1]]}"
+             f"&ref_code={ref[0]}&ref_v1={values[ref[1]]}")
+        payload, code = console.query_holds_payload(q)
+        assert code == 200
+        assert payload == {"holds": True, "generation": 0}
+        # Capture-id form agrees with the string form.
+        with svc.acquire() as r:
+            did = r.capture_id(dep[0], values[dep[1]])
+            rid = r.capture_id(ref[0], values[ref[1]])
+        payload, _ = console.query_holds_payload(f"dep={did}&ref={rid}")
+        assert payload["holds"] is True
+
+        payload, code = console.query_referenced_payload(
+            f"dep_code={dep[0]}&dep_v1={values[dep[1]]}")
+        assert code == 200 and payload["n"] == 5
+        assert payload["support"] == sup
+        assert all("pretty" in row for row in payload["referenced"])
+
+        payload, code = console.query_topk_payload("k=3")
+        assert code == 200 and len(payload["results"]) == 3
+        sups = [row["support"] for row in payload["results"]]
+        assert sups == sorted(sups, reverse=True)
+
+        # Malformed queries are 400s, not handler crashes.
+        assert console.query_holds_payload("dep=1")[1] == 400
+        assert console.query_holds_payload("dep=x&ref=y")[1] == 400
+        assert console.query_topk_payload("k=x")[1] == 400
+
+        # /status grows the serving_index struct.
+        st = console.status_payload()
+        assert st["serving_index"]["generation"] == 0
+        assert st["serving_index"]["n_cinds"] == len(table)
+    finally:
+        console.set_query_service(None)
+        svc.close()
+    # Disarmed: query routes answer 503.
+    assert console.query_holds_payload("dep=1&ref=2")[1] == 503
+
+
+# ---------------------------------------------------------------------------
+# Emit hooks: a --delta-state run commits generation 0; a --delta run
+# commits a chained generation 1 (base_output_digest -> gen-0 cert).
+# ---------------------------------------------------------------------------
+
+
+def test_driver_and_delta_emit_chained_index(tmp_path):
+    from rdfind_tpu.obs import integrity
+    from rdfind_tpu.runtime import driver
+
+    triples = synth.generate_triples(400, seed=3)
+    ins, dels = synth.grow_delta_batches(triples, 0.02, seed=4)
+    p_base = str(tmp_path / "base.nt")
+    p_ins = str(tmp_path / "ins.nt")
+    p_del = str(tmp_path / "del.nt")
+    synth.write_nt(p_base, triples)
+    synth.write_nt(p_ins, ins)
+    synth.write_nt(p_del, dels)
+    bundle = str(tmp_path / "bundle")
+
+    res0 = driver.run(driver.Config(
+        input_paths=[p_base], min_support=3, traversal_strategy=0,
+        delta_state=bundle))
+    r0 = serving.IndexReader(serving.index_path(bundle))
+    assert r0.generation == 0 and r0.base_output_digest is None
+    g0_digest = r0.output_digest
+    assert g0_digest == integrity.digest_hex(
+        *integrity.digest_table(res0.table))
+    assert r0.n_cinds == len(res0.table)
+    # The index answers the run's own first CIND.
+    dep = (int(res0.table.dep_code[0]), int(res0.table.dep_v1[0]),
+           int(res0.table.dep_v2[0]))
+    ref = (int(res0.table.ref_code[0]), int(res0.table.ref_v1[0]),
+           int(res0.table.ref_v2[0]))
+    cap_dep = r0._capture_id_ids(*dep)
+    cap_ref = r0._capture_id_ids(*ref)
+    assert r0.holds_ids(cap_dep, cap_ref)
+    # Bundle meta and index meta agree on the digest (one cert chain).
+    from rdfind_tpu.runtime import delta
+    meta = delta.load_bundle(bundle, min_support=3, projections="spo",
+                             distinct=False).meta
+    assert meta["output_digest"] == g0_digest
+    r0.close()
+
+    res1 = driver.run(driver.Config(
+        input_paths=[p_ins], delete_paths=[p_del], min_support=3,
+        traversal_strategy=0, delta_base=bundle))
+    r1 = serving.IndexReader(serving.index_path(bundle))
+    assert r1.generation == 1
+    assert r1.base_output_digest == g0_digest
+    assert r1.output_digest == integrity.digest_hex(
+        *integrity.digest_table(res1.table))
+    assert r1.n_cinds == len(res1.table)
+    r1.close()
+
+
+def test_env_index_dir_emits_everywhere(tmp_path, monkeypatch):
+    from rdfind_tpu.runtime import driver
+
+    extra = tmp_path / "extra"
+    bundle = str(tmp_path / "bundle")
+    monkeypatch.setenv("RDFIND_SERVE_INDEX", str(extra))
+    triples = synth.generate_triples(300, seed=5)
+    p = str(tmp_path / "t.nt")
+    synth.write_nt(p, triples)
+    res = driver.run(driver.Config(
+        input_paths=[p], min_support=3, traversal_strategy=0,
+        delta_state=bundle))
+    for d in (bundle, str(extra)):
+        r = serving.IndexReader(serving.index_path(d))
+        assert r.generation == 0 and r.n_cinds == len(res.table)
+        r.close()

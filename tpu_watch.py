@@ -231,6 +231,23 @@ def _corrupt_hosts(hosts: dict) -> dict:
             and b["integrity"].get("corrupt")}
 
 
+def _serving_stale_hosts(hosts: dict) -> dict:
+    """Hosts running a serving process whose bundle dir holds a NEWER
+    committed index generation than the one loaded (a pending or refused
+    hot swap — the answers are correct but out of date).  A serve host is
+    never "wedged" (idle is its steady state); staleness is its verdict."""
+    out = {}
+    for h, b in hosts.items():
+        if b.get("mode") != "serve" or b.get("final"):
+            continue
+        gen, bgen = b.get("generation"), b.get("bundle_generation")
+        behind = bgen is not None and (gen is None or bgen > gen)
+        if b.get("index_stale") or behind:
+            out[h] = {"generation": gen, "bundle_generation": bgen,
+                      "pending": b.get("pending_swap")}
+    return out
+
+
 def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
     """The wedged-vs-slow verdict over a run's obs directory (exit codes:
     0 alive/done, 1 wedged, 2 no heartbeat at all, 3 CORRUPT — an
@@ -246,12 +263,14 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
     degrading = _degrading_hosts(hosts)
     recovering = _recovering_hosts(hosts)
     corrupt = _corrupt_hosts(hosts)
+    serving_stale = _serving_stale_hosts(hosts)
     recs = _flightrec_summaries(obs_dir)
     if as_json:
         print(json.dumps({"dir": obs_dir, "state": state,
                           "degrading": bool(degrading),
                           "recovering": bool(recovering),
                           "corrupt": bool(corrupt),
+                          "serving_stale": bool(serving_stale),
                           "stale_s": stale_s, "age_s": verdict["age_s"],
                           "hosts": hosts, "flightrec": recs},
                          sort_keys=True, default=str))
@@ -274,6 +293,8 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
         # without reading the run's stats.
         if b.get("mode") == "delta":
             where = f"{where} [delta, base gen {b.get('generation')}]"
+        elif b.get("mode") == "serve":
+            where = f"{where} [serve, gen {b.get('generation')}]"
         elif b.get("mode"):
             where = f"{where} [{b.get('mode')}]"
         flags = (" (final)" if b.get("final") else
@@ -302,6 +323,14 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
             print(f"status[{obs_dir}] host {h}: CORRUPT — integrity digest "
                   f"mismatch at {iv.get('site')} ({iv.get('stage')}); the "
                   f"output is not digest-attested")
+        sv = serving_stale.get(h)
+        if sv is not None:
+            why = (f"; last swap refused: {sv['pending']}"
+                   if sv.get("pending") else "")
+            print(f"status[{obs_dir}] host {h}: SERVING-STALE — bundle "
+                  f"dir committed generation {sv['bundle_generation']} "
+                  f"but the server still answers from "
+                  f"{sv['generation']}{why}")
     # Surface the wedged host's flight recorder when one was dumped: the
     # ring of events leading into the stall, captured even with the jsonl
     # tracer off.
@@ -327,6 +356,10 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
         tail = (" (degrading: cap-exhaustion forecast active on host(s) "
                 f"{sorted(degrading)} — alive, but the degradation ladder "
                 "is imminent)")
+    elif serving_stale:
+        tail = (" (SERVING-STALE: host(s) "
+                f"{sorted(serving_stale)} answer from an older generation "
+                "than the bundle dir holds — swap pending or refused)")
     print(f"status[{obs_dir}]: {state}" + tail)
     if corrupt:
         return 3
@@ -361,6 +394,25 @@ def report_console(url: str, as_json: bool = False) -> int:
         if progress.get("run_pass") is not None:
             where = f"{where} pass {progress['run_pass']}"
         print(f"console[{base}]: pid {status.get('pid')} {state}, in {where}")
+        si = status.get("serving_index")
+        if isinstance(si, dict):
+            print(f"console[{base}]: index generation "
+                  f"{si.get('generation')} (bundle dir has "
+                  f"{si.get('bundle_generation')}), {si.get('n_cinds')} "
+                  f"CINDs, {si.get('swaps')} swap(s), "
+                  f"{si.get('refusals')} refusal(s)")
+            if si.get("stale"):
+                why = (f"; last candidate: {si.get('pending')}"
+                       if si.get("pending") else "")
+                print(f"console[{base}]: SERVING-STALE — bundle dir "
+                      f"committed generation {si.get('bundle_generation')} "
+                      f"but the server still answers from "
+                      f"{si.get('generation')}{why}")
+            for link in si.get("chain") or []:
+                print(f"console[{base}]: cert chain gen "
+                      f"{link.get('generation')}: output "
+                      f"{link.get('output_digest')} (base "
+                      f"{link.get('base_output_digest')})")
         util = progress.get("cap_utilization") or {}
         for cap, row in sorted(util.items()):
             if isinstance(row, dict):
