@@ -12,14 +12,22 @@ runtime/serving.write_index at two sizes a 10x spread apart, and measures:
   * per-query latency p50/p95/p99 for all three query types (holds,
     referenced, top-k).
 
+It also measures the observability tax and the freshness plane: the same
+query stream through the IndexService path with per-request telemetry off
+then on (answers asserted bit-identical; the instrumented path must hold
+>= 0.9x the bare QPS), and the bundle-commit -> serving-swap staleness
+across a live gen-0 -> gen-1 hot swap.
+
 Prints ONE JSON line (bench.py shape) and appends a provenance-keyed row
-to BENCH_HISTORY.jsonl; `serve_qps` / `serve_open_ms` / `serve_p99_us`
+to BENCH_HISTORY.jsonl; `serve_qps` / `serve_open_ms` / `serve_p99_us` /
+`serve_obs_qps` / `serve_obs_overhead_frac` / `serve_swap_staleness_s`
 gate in obs/sentinel.METRIC_SPECS like kernel regressions.
 
 Env: BENCH_SERVE_CINDS (default 10_000), BENCH_SERVE_QUERIES (default
 50_000), BENCH_SERVE_THREADS (default 4), BENCH_SERVE_MIN_QPS (default
 50_000; the single-thread holds() floor, 0 disables the assert),
-BENCH_HISTORY as in bench.py.
+BENCH_SERVE_OBS_MAX_FRAC (default 0.1; the instrumented-path overhead
+ceiling, 0 disables the assert), BENCH_HISTORY as in bench.py.
 """
 
 import json
@@ -203,6 +211,83 @@ def _run(n_cinds: int, n_queries: int, n_threads: int,
             print(f"bench_serve: {name} p50/p95/p99 = {p['p50']}/"
                   f"{p['p95']}/{p['p99']} us", file=sys.stderr, flush=True)
         reader.close()
+
+        # Instrumented vs bare: the same queries through the SERVICE path
+        # (slot pin + per-request telemetry) with obs off, then on.  The
+        # answers must be bit-identical and the slowdown bounded — the
+        # observability plane may not tax the query plane more than
+        # BENCH_SERVE_OBS_MAX_FRAC (default 10%, i.e. instrumented must
+        # hold >= 0.9x bare; 0 disables the assert).
+        from rdfind_tpu.obs import servestats
+        svc = serving.IndexService(big_dir, verify=False)
+        svc.poll()
+        obs_n = min(n_queries, 20_000)
+        sub = queries[:obs_n]
+        prev_obs = os.environ.get("RDFIND_SERVE_OBS")
+
+        def svc_pass():
+            qh = svc.query_holds
+            answers = []
+            t0 = time.perf_counter()
+            for dep, ref in sub:
+                answers.append(qh(dep, ref)["holds"])
+            return len(sub) / (time.perf_counter() - t0), answers
+
+        try:
+            os.environ["RDFIND_SERVE_OBS"] = "0"
+            servestats.configure()
+            svc_pass()  # warm
+            qps_bare, ans_bare = svc_pass()
+            os.environ["RDFIND_SERVE_OBS"] = "1"
+            servestats.reset()
+            servestats.configure()
+            qps_obs, ans_obs = svc_pass()
+            agg = servestats.aggregate()
+        finally:
+            if prev_obs is None:
+                os.environ.pop("RDFIND_SERVE_OBS", None)
+            else:
+                os.environ["RDFIND_SERVE_OBS"] = prev_obs
+            servestats.reset()
+            servestats.configure()
+        svc.close()
+        assert ans_bare == ans_obs, \
+            "instrumentation changed query answers (must be bit-identical)"
+        assert agg["requests"]["holds"]["ok"] == obs_n, (
+            f"sharded stats lost requests: {agg['requests']} != {obs_n}")
+        overhead = 1.0 - qps_obs / qps_bare
+        serve["holds_qps_svc_bare"] = round(qps_bare, 1)
+        serve["holds_qps_svc_obs"] = round(qps_obs, 1)
+        serve["obs_overhead_frac"] = round(overhead, 4)
+        print(f"bench_serve: service holds() {qps_bare:,.0f} QPS bare vs "
+              f"{qps_obs:,.0f} instrumented (overhead "
+              f"{overhead * 100:.1f}%)", file=sys.stderr, flush=True)
+        max_frac = float(os.environ.get("BENCH_SERVE_OBS_MAX_FRAC", 0.1))
+        if max_frac:
+            assert overhead <= max_frac, (
+                f"observability overhead {overhead * 100:.1f}% > "
+                f"{max_frac * 100:.0f}% (instrumented serving must hold "
+                f">= {1 - max_frac:.1f}x the bare-path QPS; "
+                f"BENCH_SERVE_OBS_MAX_FRAC=0 disables)")
+
+        # Freshness across a LIVE gen-0 -> gen-1 hot swap: the recorded
+        # staleness is the bundle-commit -> serving-swap lag.
+        swap_dir = os.path.join(root, "swap")
+        serving.write_index(swap_dir, values_s, table_s, generation=0,
+                            output_digest="bench-g0")
+        svc2 = serving.IndexService(swap_dir, verify=False)
+        assert svc2.poll()["action"] == "swapped"
+        serving.write_index(swap_dir, values_s, table_s, generation=1,
+                            output_digest="bench-g1",
+                            base_output_digest="bench-g0")
+        verdict = svc2.poll()
+        assert verdict["action"] == "swapped", verdict
+        fresh = svc2.freshness()
+        svc2.close()
+        assert fresh["generations_behind"] == 0, fresh
+        serve["swap_staleness_s"] = fresh["staleness_s"]
+        print(f"bench_serve: gen-0->1 swap staleness "
+              f"{fresh['staleness_s']}s", file=sys.stderr, flush=True)
 
     detail["serve"] = serve
     detail["workload"] = {"bench": "serve", "n_cinds": serve["n_cinds"],

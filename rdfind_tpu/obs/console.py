@@ -29,8 +29,22 @@ console grows from a diagnostics endpoint into the query plane:
   /query/referenced?dep=ID[&limit] what the dependent references + support
   /query/topk?k=N                  the k CINDs with the largest support
 
-and /status gains a "serving_index" struct: loaded vs on-disk generation,
-pending-swap verdict, and the loaded-generation certificate chain.
+and /status gains a "serving_index" struct (loaded vs on-disk generation,
+pending-swap verdict, freshness, the loaded-generation certificate chain)
+plus the named SLO verdict; the admin plane grows two more routes:
+
+  /slo                             the SLO engine's verdict (ok/warn/
+                                   burning + which SLO), its config, the
+                                   freshness plane, and the aggregated
+                                   request counters
+  /debug/slowlog                   the bounded slow-query ring (args,
+                                   latency, generation — obs/servestats)
+
+and /metrics appends the sharded per-request serving stats (request
+counters by endpoint×outcome, latency summaries) to the registry's
+exposition.  Every non-200 the query plane returns is counted
+(serve_http_400/serve_http_503 + the servestats outcome counters), so
+refused or malformed traffic is visible, not silent.
 
 Everything is read-only and served from in-process state (the registry,
 the flight recorder, the heartbeat directory) — the handler threads never
@@ -49,7 +63,7 @@ import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import flightrec, heartbeat, metrics
+from . import flightrec, heartbeat, metrics, servestats
 
 DEFAULT_HOST = "127.0.0.1"
 
@@ -172,7 +186,36 @@ def status_payload() -> dict:
         out["heartbeat"] = heartbeat.assess(_OBS_DIR)
     if _QUERY_SERVICE is not None:
         out["serving_index"] = _QUERY_SERVICE.status()
+        out["slo"] = servestats.evaluate_slo(
+            out["serving_index"].get("freshness"))
     return out
+
+
+def slo_payload() -> dict:
+    """The /slo admin view: the named verdict, the engine's targets, the
+    freshness plane, and the aggregated per-request counters."""
+    fresh = (_QUERY_SERVICE.freshness()
+             if _QUERY_SERVICE is not None else None)
+    return {"verdict": servestats.evaluate_slo(fresh),
+            "config": servestats.slo_config(),
+            "freshness": fresh,
+            "requests": servestats.aggregate()}
+
+
+def slowlog_payload() -> dict:
+    entries = servestats.slowlog()
+    return {"enabled": servestats.enabled(),
+            "slow_us": servestats.slow_us(),
+            "n_entries": len(entries), "entries": entries}
+
+
+def _reject(endpoint: str, payload: dict, code: int) -> tuple[dict, int]:
+    """Route a non-200 query answer through the counters (ISSUE 20
+    satellite bugfix: refused/malformed traffic used to vanish — no
+    counter anywhere)."""
+    servestats.record(endpoint, str(code))
+    metrics.counter_add(None, f"serve_http_{code}")
+    return payload, code
 
 
 def _capture_arg(q: dict, role: str):
@@ -190,39 +233,51 @@ def _capture_arg(q: dict, role: str):
     return (int(q[code_key][0]), v1, v2)
 
 
+def _answer(endpoint: str, payload: dict) -> tuple[dict, int]:
+    """An IndexService answer → (payload, HTTP code).  'no index loaded'
+    is a 503, not a 200: the service already counted the refusal; the
+    HTTP plane only maps the code (and counts it)."""
+    if payload.get("error") == "no index loaded":
+        metrics.counter_add(None, "serve_http_503")
+        return payload, 503
+    return payload, 200
+
+
 def query_holds_payload(query: str) -> tuple[dict, int]:
     if _QUERY_SERVICE is None:
-        return {"error": "no query service armed"}, 503
+        return _reject("holds", {"error": "no query service armed"}, 503)
     q = urllib.parse.parse_qs(query)
     try:
         dep = _capture_arg(q, "dep")
         ref = _capture_arg(q, "ref")
     except ValueError as e:
-        return {"error": str(e)}, 400
-    return _QUERY_SERVICE.query_holds(dep, ref), 200
+        return _reject("holds", {"error": str(e)}, 400)
+    return _answer("holds", _QUERY_SERVICE.query_holds(dep, ref))
 
 
 def query_referenced_payload(query: str) -> tuple[dict, int]:
     if _QUERY_SERVICE is None:
-        return {"error": "no query service armed"}, 503
+        return _reject("referenced",
+                       {"error": "no query service armed"}, 503)
     q = urllib.parse.parse_qs(query)
     try:
         dep = _capture_arg(q, "dep")
         limit = int(q["limit"][0]) if "limit" in q else None
     except ValueError as e:
-        return {"error": str(e)}, 400
-    return _QUERY_SERVICE.query_referenced(dep, limit=limit), 200
+        return _reject("referenced", {"error": str(e)}, 400)
+    return _answer("referenced",
+                   _QUERY_SERVICE.query_referenced(dep, limit=limit))
 
 
 def query_topk_payload(query: str) -> tuple[dict, int]:
     if _QUERY_SERVICE is None:
-        return {"error": "no query service armed"}, 503
+        return _reject("topk", {"error": "no query service armed"}, 503)
     q = urllib.parse.parse_qs(query)
     try:
         k = int(q.get("k", ["10"])[0])
     except ValueError as e:
-        return {"error": str(e)}, 400
-    return _QUERY_SERVICE.query_topk(k), 200
+        return _reject("topk", {"error": str(e)}, 400)
+    return _answer("topk", _QUERY_SERVICE.query_topk(k))
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -251,8 +306,10 @@ class _Handler(BaseHTTPRequestHandler):
         query = parts[1] if len(parts) > 1 else ""
         try:
             if path == "/metrics":
-                self._send(metrics.registry().prometheus_text(),
-                           "text/plain; version=0.0.4")
+                body = metrics.registry().prometheus_text()
+                if _QUERY_SERVICE is not None:
+                    body += servestats.prometheus_text()
+                self._send(body, "text/plain; version=0.0.4")
             elif path == "/status":
                 self._send_json(status_payload())
             elif path == "/progress":
@@ -264,6 +321,10 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/flightrec":
                 self._send_json({"enabled": flightrec.enabled(),
                                  "events": flightrec.snapshot()})
+            elif path == "/slo":
+                self._send_json(slo_payload())
+            elif path == "/debug/slowlog":
+                self._send_json(slowlog_payload())
             elif path == "/query/holds":
                 self._send_json(*query_holds_payload(query))
             elif path == "/query/referenced":
@@ -272,7 +333,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(*query_topk_payload(query))
             elif path == "/":
                 endpoints = ["/metrics", "/status", "/progress",
-                             "/datastats", "/integrity", "/flightrec"]
+                             "/datastats", "/integrity", "/flightrec",
+                             "/slo", "/debug/slowlog"]
                 if _QUERY_SERVICE is not None:
                     endpoints += ["/query/holds", "/query/referenced",
                                   "/query/topk"]

@@ -53,6 +53,39 @@ _HIST_UNDERFLOW = -(1 << 30)  # single bucket for values <= 0
 QUANTILES = (0.5, 0.95, 0.99)
 
 
+def hist_bucket(value: float) -> int:
+    """The log-bucket index ``Histogram.observe`` files `value` under.
+    Shared with the serving stats shards (obs/servestats.py) so their
+    scrape-time quantiles use bit-identical bucketing."""
+    if value > 0.0:
+        return math.floor(math.log(value) / _HIST_LOG_BASE)
+    return _HIST_UNDERFLOW
+
+
+def bucket_quantile(buckets: dict, q: float, vmin: float | None = None,
+                    vmax: float | None = None) -> float | None:
+    """Approximate q-quantile of a {bucket_index: count} map (bucket
+    midpoint, clamped to [vmin, vmax] when given).  The merge-side twin of
+    ``Histogram.quantile`` for histograms aggregated across shards."""
+    count = sum(buckets.values())
+    if not count:
+        return None
+    rank = q * (count - 1)
+    acc = 0
+    for b in sorted(buckets):
+        acc += buckets[b]
+        if acc > rank:
+            if b == _HIST_UNDERFLOW:
+                return vmin if vmin is not None else 0.0
+            mid = (_HIST_BASE ** b + _HIST_BASE ** (b + 1)) / 2.0
+            if vmin is not None:
+                mid = max(mid, vmin)
+            if vmax is not None:
+                mid = min(mid, vmax)
+            return mid
+    return vmax if vmax is not None else None
+
+
 class Histogram:
     """Fixed-size summary of an observation stream (no per-sample storage);
     sparse log buckets give approximate quantiles."""
@@ -72,10 +105,7 @@ class Histogram:
         self.total += value
         self.min = min(self.min, value)
         self.max = max(self.max, value)
-        if value > 0.0:
-            b = math.floor(math.log(value) / _HIST_LOG_BASE)
-        else:
-            b = _HIST_UNDERFLOW
+        b = hist_bucket(value)
         self._buckets[b] = self._buckets.get(b, 0) + 1
 
     def quantile(self, q: float) -> float | None:

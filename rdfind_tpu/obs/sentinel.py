@@ -154,6 +154,15 @@ METRIC_SPECS = (
     ("serve_qps", ("detail", "serve", "holds_qps"), "higher"),
     ("serve_open_ms", ("detail", "serve", "open_ms"), "lower"),
     ("serve_p99_us", ("detail", "serve", "holds_p99_us"), "lower"),
+    # The observability plane's own contract (ISSUE 20): instrumented
+    # service-path QPS, the telemetry overhead fraction, and the
+    # bundle-commit -> serving-swap staleness across a live hot swap.
+    ("serve_obs_qps",
+     ("detail", "serve", "holds_qps_svc_obs"), "higher"),
+    ("serve_obs_overhead_frac",
+     ("detail", "serve", "obs_overhead_frac"), "lower"),
+    ("serve_swap_staleness_s",
+     ("detail", "serve", "swap_staleness_s"), "lower"),
 )
 _DIRECTIONS = {name: d for name, _, d in METRIC_SPECS}
 

@@ -7,13 +7,18 @@ with ``RDFIND_SERVE_INDEX``).  The process:
   * opens the index zero-copy (runtime/serving.IndexReader — O(header));
   * serves the loopback console grown into the query plane
     (/query/holds, /query/referenced, /query/topk, plus /status with the
-    index generation, integrity verdict, and certificate chain);
+    index generation, integrity verdict, and certificate chain) and its
+    admin plane (/metrics with the sharded per-request stats, /slo with
+    the named SLO verdict, /debug/slowlog with the slow-query ring);
   * polls DIR (RDFIND_SERVE_POLL_S) and hot-swaps generations: when a
     delta run commits N+1 the new mapping is digest-verified and
     chain-checked, then atomically swapped in with zero dropped queries;
-  * beats ``mode="serve"`` heartbeats into --obs so tpu_watch sees
-    generation/pending-swap state and heartbeat.assess never wedge-flags
-    an idle server.
+  * beats ``mode="serve"`` heartbeats into --obs carrying the freshness
+    plane (index_age_s / staleness_s / generations_behind) and the SLO
+    verdict, so tpu_watch sees generation/pending-swap/SLO state and
+    heartbeat.assess never wedge-flags an idle server;
+  * dumps the slow-query ring to --obs on SIGTERM and clean exit
+    (slowlog-host<N>.json — the flightrec idiom).
 
 Pure host-side stdlib+numpy: no JAX, no devices — a serving box needs
 neither.
@@ -23,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import sys
 import time
 
@@ -54,9 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    from ..obs import console, heartbeat
+    from ..obs import console, heartbeat, servestats
     from ..runtime import serving
 
+    servestats.configure()
     poll = serving.poll_s() if args.poll_s is None else max(0.05,
                                                             args.poll_s)
     svc = serving.IndexService(args.index_dir)
@@ -88,6 +95,10 @@ def main(argv=None) -> int:
               file=sys.stderr)
 
     def beat(final: bool = False) -> None:
+        # The SLO engine evaluates on every beat even without --obs: the
+        # loop IS its snapshot cadence for the burn-rate windows.
+        fresh = svc.freshness()
+        slo = servestats.evaluate_slo(fresh)
         if not args.obs:
             return
         os.makedirs(args.obs, exist_ok=True)
@@ -98,7 +109,22 @@ def main(argv=None) -> int:
             "bundle_generation": st["bundle_generation"],
             "pending_swap": st["pending"],
             "index_stale": st["stale"], "swaps": st["swaps"],
+            "index_age_s": fresh["index_age_s"],
+            "staleness_s": fresh["staleness_s"],
+            "generations_behind": fresh["generations_behind"],
+            "slo": {"state": slo["state"], "slo": slo["slo"]},
             "console_port": port}, final=final)
+
+    def _on_term(signum, frame):
+        # Dump the slow-query ring before dying; SystemExit unwinds into
+        # the finally block (final beat, console stop, service close).
+        servestats.dump_slowlog(args.obs or ".", reason=f"signal-{signum}")
+        raise SystemExit(128 + signum)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass  # not the main thread, or an exotic platform: skip the hook
 
     beat()
     t0 = time.monotonic()
@@ -119,6 +145,8 @@ def main(argv=None) -> int:
         pass
     finally:
         beat(final=True)
+        if args.obs:
+            servestats.dump_slowlog(args.obs, reason="exit")
         console.stop()
         svc.close()
     return 0

@@ -345,6 +345,11 @@ def save_bundle(base_dir: str, meta: dict, ids: np.ndarray,
                {"rows": np.asarray(rows, np.int64),
                 "bucket_offsets": offsets})
     store.save(_STAGE_CINDS, fp, {"full": np.asarray(full, np.int64)})
+    # The wall-clock commit stamp for the freshness plane: taken HERE, at
+    # the meta write — the bundle's actual commit point — not when the
+    # caller assembled the meta.  Mutates the caller's dict on purpose, so
+    # downstream emit hooks see the committed time.
+    meta["commit_unix"] = round(time.time(), 3)
     blob = json.dumps(meta, sort_keys=True).encode("utf-8")
     store.save(_STAGE_META, _meta_fp(),
                {"meta_json": np.frombuffer(blob, np.uint8)})
@@ -778,7 +783,7 @@ def _recompute(bundle_rows: np.ndarray, full: np.ndarray, ids: np.ndarray,
 
 
 def write_base_bundle(cfg, ids: np.ndarray, dictionary, table,
-                      stats: dict | None, timings: dict) -> None:
+                      stats: dict | None, timings: dict) -> dict:
     """Persist generation 0 after a full run.  At generation 0 internal ids
     == canonical ids (the dictionary is sorted), so the run's own artifacts
     are stored as-is."""
@@ -816,6 +821,7 @@ def write_base_bundle(cfg, ids: np.ndarray, dictionary, table,
         base_output_digest=None,
         base_wall_s=round(base_wall, 6),
         created_unix=round(time.time(), 3),
+        batch={"inserts": int(ids.shape[0]), "deletes": 0},
     )
     save_bundle(cfg.delta_state, meta, ids, values, rows, full)
     metrics.struct_set(stats, "delta_state", {
@@ -824,6 +830,7 @@ def write_base_bundle(cfg, ids: np.ndarray, dictionary, table,
         "num_buckets": buckets, "n_passes": passes})
     tracer.instant("delta_state", cat=tracer.CAT_RUN, generation=0,
                    n_rows=int(rows.shape[0]))
+    return meta
 
 
 # ---------------------------------------------------------------------------
@@ -1028,19 +1035,27 @@ def run_delta(cfg, phases, counters: dict, stats: dict):
             output_digest=integrity.digest_hex(
                 *integrity.digest_table(table)),
             created_unix=round(time.time(), 3),
+            batch={"inserts": int(ins_tok.shape[0]),
+                   "deletes": int(del_tok.shape[0]),
+                   "base_generation": generation},
         )
         save_bundle(cfg.delta_base, new_meta, ids2, values, upd_rows,
                     merged_full)
-    phases.run("delta-state", save_state)
+        return new_meta
+    new_meta = phases.run("delta-state", save_state)
     metrics.struct_update(stats, "delta", new_generation=generation + 1)
     # Commit the servable generation next to the advanced bundle: a serving
     # process polling the dir digest-verifies it, checks the certificate
     # chain (base_output_digest == the generation it loaded), and hot-swaps.
+    # The bundle's commit stamp and batch identity ride into the index meta
+    # — they are the anchors the serving freshness plane measures against.
     phases.run("serve-index", lambda: serving.emit_index(
         [cfg.delta_base], dictionary, table, generation=generation + 1,
         base_output_digest=meta["output_digest"],
         strategy=cfg.traversal_strategy, min_support=cfg.min_support,
-        stats=stats))
+        stats=stats,
+        extra={"bundle_commit_unix": new_meta.get("commit_unix"),
+               "batch": new_meta.get("batch")}))
 
     counters["cind-counter"] = len(table)
     counters.update({f"stat-{k}": v for k, v in stats.items()})

@@ -1061,19 +1061,25 @@ def _run(cfg: Config) -> RunResult:
                 progress.cleanup()  # per-pass snapshots are now superseded
             phases.run("checkpoint-discover", save_discover)
     counters["cind-counter"] = len(table)
+    base_meta: dict = {}
     if cfg.delta_state and _is_primary():
         # Persist the base bundle (generation 0) the incremental runs load.
         from . import delta
-        phases.run("delta-state", lambda: delta.write_base_bundle(
-            cfg, ids, dictionary, table, stats, phases.timings))
+        base_meta = phases.run("delta-state", lambda: delta.write_base_bundle(
+            cfg, ids, dictionary, table, stats, phases.timings)) or {}
     if _is_primary() and (cfg.delta_state or serving.env_index_dir()):
         # The servable artifact: generation-0 mmap index next to the bundle
-        # (and/or into RDFIND_SERVE_INDEX) for runtime/serving readers.
+        # (and/or into RDFIND_SERVE_INDEX) for runtime/serving readers.  The
+        # bundle's commit stamp + batch identity ride into the index meta so
+        # the serving freshness plane measures gen 0 the same way as gen N
+        # (None values are stripped; created_unix backstops the stamp).
         phases.run("serve-index", lambda: serving.emit_index(
             [cfg.delta_state] if cfg.delta_state else [],
             dictionary, table, generation=0, base_output_digest=None,
             strategy=cfg.traversal_strategy, min_support=cfg.min_support,
-            stats=stats))
+            stats=stats,
+            extra={"bundle_commit_unix": base_meta.get("commit_unix"),
+                   "batch": base_meta.get("batch")}))
     counters.update({f"stat-{k}": v for k, v in stats.items()})
     _emit_sinks(cfg, phases, counters, table, dictionary, stats, ids)
 

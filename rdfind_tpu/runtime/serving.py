@@ -76,7 +76,7 @@ import numpy as np
 
 from .. import conditions as cc
 from ..data import NO_VALUE
-from ..obs import integrity, metrics, tracer
+from ..obs import integrity, metrics, servestats, tracer
 
 INDEX_FILE = "cind_index.bin"
 INDEX_FORMAT = 1
@@ -223,10 +223,16 @@ def write_index(directory: str, values, table, *, generation: int,
     arrays = build_arrays(values, table)
     arrays = {k: np.ascontiguousarray(arrays[k]).astype(_DTYPES[k])
               for k in _SECTIONS}
+    created = round(time.time(), 3)
     meta = {
         "format": INDEX_FORMAT,
         "generation": int(generation),
-        "created_unix": round(time.time(), 3),
+        "created_unix": created,
+        # The freshness anchor: when the DATA this index serves was
+        # committed (delta bundle meta-write time).  `extra` overrides it
+        # with the real bundle commit stamp; a standalone write (tests,
+        # full runs) defaults to its own creation time.
+        "bundle_commit_unix": created,
         "n_values": int(len(arrays["dict_offsets"]) - 1),
         "n_captures": int(len(arrays["cap_code"])),
         "n_deps": int(len(arrays["dep_ids"])),
@@ -236,7 +242,7 @@ def write_index(directory: str, values, table, *, generation: int,
                                else str(base_output_digest)),
     }
     if extra:
-        meta.update(extra)
+        meta.update({k: v for k, v in extra.items() if v is not None})
 
     def _layout(header_len: int) -> list[dict]:
         off = header_len
@@ -295,9 +301,12 @@ def write_index(directory: str, values, table, *, generation: int,
 
 def emit_index(dirs, dictionary, table, *, generation: int,
                base_output_digest: str | None, strategy: int,
-               min_support: int, stats: dict | None = None) -> list[str]:
+               min_support: int, stats: dict | None = None,
+               extra: dict | None = None) -> list[str]:
     """The driver/delta emit hook: write the run's index into every
-    directory in `dirs` plus RDFIND_SERVE_INDEX when set."""
+    directory in `dirs` plus RDFIND_SERVE_INDEX when set.  `extra` rides
+    into the index meta (the delta path threads its bundle commit stamp
+    and batch identity through here)."""
     targets = []
     for d in list(dirs) + [env_index_dir()]:
         if d and d not in targets:
@@ -305,14 +314,16 @@ def emit_index(dirs, dictionary, table, *, generation: int,
     if not targets:
         return []
     output_digest = integrity.digest_hex(*integrity.digest_table(table))
+    meta_extra = {"strategy": int(strategy), "min_support": int(min_support)}
+    if extra:
+        meta_extra.update(extra)
     written = []
     for d in targets:
         written.append(write_index(
             d, dictionary.values, table, generation=generation,
             output_digest=output_digest,
             base_output_digest=base_output_digest,
-            extra={"strategy": int(strategy),
-                   "min_support": int(min_support)}))
+            extra=meta_extra))
     metrics.struct_set(stats, "serve_index", {
         "dirs": targets, "generation": int(generation),
         "n_cinds": len(table), "output_digest": output_digest})
@@ -326,9 +337,9 @@ def emit_index(dirs, dictionary, table, *, generation: int,
 # ---------------------------------------------------------------------------
 
 
-def peek_generation(path: str) -> int | None:
-    """O(header) peek at an index file's generation (None on any miss) —
-    how a watcher tells 'the bundle dir moved on' without mapping it."""
+def peek_meta(path: str) -> dict | None:
+    """O(header) peek at an index file's meta (None on any miss) — how a
+    watcher tells 'the bundle dir moved on' without mapping it."""
     try:
         with open(path, "rb") as f:
             head = f.read(16)
@@ -344,9 +355,15 @@ def peek_generation(path: str) -> int | None:
             for s in meta["sections"]:
                 if int(s["offset"]) + int(s["nbytes"]) > size:
                     return None
-            return int(meta["generation"])
+            int(meta["generation"])
+            return meta
     except (OSError, ValueError, KeyError, TypeError):
         return None
+
+
+def peek_generation(path: str) -> int | None:
+    meta = peek_meta(path)
+    return None if meta is None else int(meta["generation"])
 
 
 class IndexReader:
@@ -406,6 +423,12 @@ class IndexReader:
         self.n_values = int(meta.get("n_values", 0))
         self.n_captures = int(meta.get("n_captures", 0))
         self.n_cinds = int(meta.get("n_cinds", 0))
+        self.created_unix = meta.get("created_unix")
+        # Pre-PR-20 indexes have no commit stamp: fall back to the write
+        # time so freshness degrades to index age, never crashes.
+        self.bundle_commit_unix = meta.get("bundle_commit_unix",
+                                           self.created_unix)
+        self.batch = meta.get("batch")
         self._vcache: dict | None = {} if cache_enabled() else None
         self._ccache: dict | None = {} if cache_enabled() else None
 
@@ -655,6 +678,7 @@ class IndexService:
         self.refusals = 0
         self.pending: dict | None = None  # last refused/missed candidate
         self.chain: list[dict] = []       # loaded-generation lineage
+        self.last_swap: dict | None = None  # staleness of the last swap
 
     # -- the active reader ---------------------------------------------------
 
@@ -702,29 +726,53 @@ class IndexService:
                     "reason": "miss", "detail": str(e)}
         verdict = self._admit(reader)
         if verdict is not None:
+            cand_digest = reader.output_digest
             reader.close()
             self.refusals += 1
             self.pending = verdict
             metrics.counter_add(None, "serve_swap_refused")
+            # The refusal instant chains to the candidate's certificate
+            # digest, so a trace reader can tie it to the rejected bundle.
+            tracer.instant("serve_swap_refused", cat=tracer.CAT_RUN,
+                           reason=verdict["reason"],
+                           generation=verdict.get("generation"),
+                           output_digest=cand_digest)
             if verdict["reason"] == "section-digest-mismatch":
                 for name in verdict["sections"]:
                     integrity.note_mismatch(stats, site="serve-swap",
                                             stage=f"index-{name}")
             return {"action": "refused", **verdict}
+        loaded = round(time.time(), 3)
+        # Swap staleness: how long the committed data waited before it
+        # started serving (bundle-commit → serving-swap lag).
+        commit = reader.bundle_commit_unix
+        swap_stale = (round(max(0.0, loaded - commit), 3)
+                      if commit is not None else None)
         with self._lock:
             old, self._slot = self._slot, _Slot(reader)
             self._stat = key
             self.swaps += 1
             self.pending = None
+            self.last_swap = {"generation": reader.generation,
+                              "loaded_unix": loaded,
+                              "bundle_commit_unix": commit,
+                              "staleness_s": swap_stale}
             self.chain.append({
                 "generation": reader.generation,
                 "output_digest": reader.output_digest,
                 "base_output_digest": reader.base_output_digest,
-                "loaded_unix": round(time.time(), 3)})
+                "loaded_unix": loaded})
         if old is not None:
             old.retire()
         metrics.gauge_set(None, "serve_generation", reader.generation)
         metrics.counter_add(None, "serve_swaps")
+        if swap_stale is not None:
+            metrics.gauge_set(None, "serve_swap_staleness_s", swap_stale)
+        tracer.instant("serve_swap", cat=tracer.CAT_RUN,
+                       generation=reader.generation,
+                       output_digest=reader.output_digest,
+                       base_output_digest=reader.base_output_digest,
+                       staleness_s=swap_stale)
         return {"action": "swapped", "generation": reader.generation}
 
     def _admit(self, reader: IndexReader) -> dict | None:
@@ -758,6 +806,42 @@ class IndexService:
         run ahead of the loaded one when a swap is pending or refused."""
         return peek_generation(self.path)
 
+    def freshness(self, now: float | None = None) -> dict:
+        """The freshness plane, in seconds and generations:
+
+          index_age_s        now − loaded index's bundle commit time (how
+                             old the data being SERVED is);
+          generations_behind bundle generation on disk − loaded generation
+                             (>0 while a swap is pending or refused);
+          staleness_s        bundle-commit → serving-swap lag.  While
+                             behind, it grows live from the PENDING
+                             bundle's commit stamp (how long fresher data
+                             has been waiting); once caught up it is the
+                             last swap's recorded lag.
+        """
+        now = time.time() if now is None else now
+        slot = self._slot
+        r = slot.reader if slot else None
+        commit = r.bundle_commit_unix if r else None
+        age = (round(max(0.0, now - commit), 3)
+               if commit is not None else None)
+        loaded = r.generation if r else None
+        disk_meta = peek_meta(self.path)
+        bundle_gen = (int(disk_meta["generation"]) if disk_meta else None)
+        behind = (max(0, bundle_gen - loaded)
+                  if bundle_gen is not None and loaded is not None
+                  else (1 if bundle_gen is not None and loaded is None
+                        else 0))
+        if behind > 0 and disk_meta is not None:
+            pend_commit = disk_meta.get("bundle_commit_unix",
+                                        disk_meta.get("created_unix"))
+            stale = (round(max(0.0, now - pend_commit), 3)
+                     if pend_commit is not None else None)
+        else:
+            stale = (self.last_swap or {}).get("staleness_s")
+        return {"index_age_s": age, "generations_behind": behind,
+                "staleness_s": stale}
+
     def status(self) -> dict:
         slot = self._slot
         r = slot.reader if slot else None
@@ -777,6 +861,8 @@ class IndexService:
             "n_cinds": r.n_cinds if r else None,
             "n_captures": r.n_captures if r else None,
             "n_values": r.n_values if r else None,
+            "batch": r.batch if r else None,
+            "freshness": self.freshness(),
             "chain": self.chain[-8:],
         }
 
@@ -789,20 +875,34 @@ class IndexService:
 
     # -- instrumented queries (the console's query plane) --------------------
 
-    def _timed(self, name: str, fn):
+    def _timed(self, name: str, fn, args=None):
+        """Run one query against a pinned reader, landing its latency in
+        the sharded serve stats (obs/servestats: per-thread, lock-free —
+        the PR-5 registry's RLock would serialize the query plane).  The
+        slot is acquired inline rather than through ``acquire()``: at
+        100k+ QPS the contextmanager frames are measurable."""
         t0 = time.perf_counter()
-        with self.acquire() as r:
-            if r is None:
-                return None, None
+        with self._lock:
+            slot = self._slot
+            r = slot.acquire() if slot else None
+        if r is None:
+            # Rare path: no generation loaded.  The registry lock is fine
+            # here, and the refusal must be visible in both planes.
+            servestats.record(name, "refused", args=args)
+            metrics.counter_add(None, "serve_refused")
+            return None, None
+        try:
             out = fn(r)
             gen = r.generation
-        metrics.observe(f"serve_{name}_us",
-                        (time.perf_counter() - t0) * 1e6)
-        metrics.counter_add(None, "serve_queries")
+        finally:
+            slot.release()
+        servestats.record(name, "ok", (time.perf_counter() - t0) * 1e6,
+                          generation=gen, args=args)
         return out, gen
 
     def query_holds(self, dep, ref) -> dict:
-        out, gen = self._timed("holds", lambda r: r.holds(dep, ref))
+        out, gen = self._timed("holds", lambda r: r.holds(dep, ref),
+                               args=(dep, ref))
         if gen is None:
             return {"error": "no index loaded"}
         return {"holds": bool(out), "generation": gen}
@@ -814,7 +914,7 @@ class IndexService:
                 {"code": c, "v1": v1, "v2": v2,
                  "pretty": cc.pretty(c, v1, v2)} for c, v1, v2 in refs],
                 "support": r.support(dep)}
-        out, gen = self._timed("referenced", run)
+        out, gen = self._timed("referenced", run, args=(dep, limit))
         if gen is None:
             return {"error": "no index loaded"}
         return {**out, "n": len(out["referenced"]), "generation": gen}
@@ -823,7 +923,7 @@ class IndexService:
         def run(r):
             return [{"dep": r.pretty_capture(d), "ref": r.pretty_capture(f),
                      "support": s} for d, f, s in r.topk(k)]
-        out, gen = self._timed("topk", run)
+        out, gen = self._timed("topk", run, args=(int(k),))
         if gen is None:
             return {"error": "no index loaded"}
         return {"k": int(k), "results": out, "generation": gen}

@@ -1,0 +1,305 @@
+"""Serving-observability parity gate: the admin plane, probed live.
+
+scripts/verify.sh runs this after the serve parity gate
+(VERIFY_SKIP_SERVE_OBS=1 opts out).  It plants a tiny index, launches the
+real serving process (programs/serve) with an ephemeral console port, and
+checks the ISSUE-20 observability contract end to end:
+
+  1. telemetry parity — a known mix of good and malformed queries is
+     fired at the live server; /metrics must parse as Prometheus text,
+     the request counters must equal the fired counts exactly (by
+     endpoint x outcome, malformed traffic included — the satellite
+     bugfix), and the latency histogram _count must equal the ok count;
+  2. planted slow path — the server runs with RDFIND_SLO_P99_US=1 (every
+     real query exceeds 1us), so /slo, /status, the heartbeat, and
+     ``tpu_watch --status --json`` must all name the burning SLO ("p99");
+     SIGTERM must dump the slow-query ring into --obs;
+  3. planted stale bundle — a chain-broken generation 1 with an old
+     commit stamp is committed under a server holding generation 0 with
+     RDFIND_SLO_STALENESS_S=5; the refused swap must surface as the
+     "staleness" SLO burning AND the SERVING-STALE verdict, on /slo and
+     in ``tpu_watch --status --json``;
+  4. obs on/off parity — the same query set against a server with
+     RDFIND_SERVE_OBS=0 must return byte-identical response bodies.
+
+A loopback bind failure is a graceful SKIP (exit 0), not a failure — the
+console is best-effort by design.  Exit codes: 0 ok/skip, 1 failure.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+URL_RE = re.compile(r"console on (http://[0-9.]+:\d+)")
+# Prometheus text exposition: comments/blank lines, or `name{labels} value`.
+SAMPLE_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$")
+
+N_ANS = 25   # the fixed answer set compared byte-for-byte obs on/off
+N_OK = 40    # extra well-formed queries
+N_BAD = 7    # malformed queries (must count as outcome="400")
+
+
+def fetch(url: str, timeout: float = 10.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+class Server:
+    """One live serving process; parses the console URL off stderr."""
+
+    def __init__(self, index_dir: str, obs_dir: str | None = None,
+                 env_extra: dict | None = None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(env_extra or {})
+        cmd = [sys.executable, "-m", "rdfind_tpu.programs.serve",
+               index_dir, "--console-port", "0", "--max-s", "60",
+               "--poll-s", "0.1"]
+        if obs_dir:
+            cmd += ["--obs", obs_dir]
+        self.child = subprocess.Popen(cmd, cwd=REPO, env=env,
+                                      stdout=subprocess.DEVNULL,
+                                      stderr=subprocess.PIPE, text=True)
+        self.base = None
+        self.bind_failed = False
+        deadline = time.time() + 60
+        for line in self.child.stderr:
+            if "console bind failed" in line:
+                self.bind_failed = True
+                break
+            m = URL_RE.search(line)
+            if m:
+                self.base = m.group(1)
+                break
+            if time.time() > deadline:
+                break
+
+    def stop(self, sig=signal.SIGTERM) -> int:
+        try:
+            self.child.send_signal(sig)
+            return self.child.wait(timeout=30)
+        finally:
+            self.child.stderr.close()
+
+    def kill(self) -> None:
+        self.child.kill()
+        self.child.stderr.close()
+
+
+def _prom_value(text: str, name: str, labels: str | None = None):
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if labels is not None:
+            if not rest.startswith("{"):
+                continue
+            got = rest[1:rest.index("}")]
+            if set(got.split(",")) != set(labels.split(",")):
+                continue
+            rest = rest[rest.index("}") + 1:]
+        elif rest.startswith("{"):
+            continue
+        try:
+            return float(rest.strip().split()[0])
+        except (ValueError, IndexError):
+            return None
+    return None
+
+
+def _watch_json(obs_dir: str):
+    out = subprocess.run(
+        [sys.executable, "tpu_watch.py", "--status", obs_dir, "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    return out.returncode, json.loads(out.stdout)
+
+
+def main() -> int:
+    from bench_serve import _planted
+    from rdfind_tpu.runtime import serving
+
+    failures = []
+    values, table = _planted(400, seed=7)
+    hold_q = "dep=0&ref=0"
+    ans_urls = [f"/query/holds?dep={i % 50}&ref={i % 37}"
+                for i in range(N_ANS)]
+
+    with tempfile.TemporaryDirectory(prefix="serve_obs_") as root:
+        idx_a = os.path.join(root, "idx_a")
+        idx_stale = os.path.join(root, "idx_stale")
+        obs_a = os.path.join(root, "obs_a")
+        obs_stale = os.path.join(root, "obs_stale")
+        serving.write_index(idx_a, values, table, generation=0,
+                            output_digest="obs-g0")
+        serving.write_index(idx_stale, values, table, generation=0,
+                            output_digest="stale-g0")
+
+        # --- 1+2: telemetry parity + planted slow path (p99 SLO) -----------
+        srv = Server(idx_a, obs_dir=obs_a,
+                     env_extra={"RDFIND_SLO_P99_US": "1"})
+        if srv.bind_failed:
+            srv.kill()
+            print("serve_obs_parity: SKIP (console could not bind a "
+                  "loopback port in this environment)")
+            return 0
+        if srv.base is None:
+            srv.kill()
+            print("serve_obs_parity: FAIL — server never announced a "
+                  "console URL", file=sys.stderr)
+            return 1
+        try:
+            answers_on = [fetch(srv.base + u) for u in ans_urls]
+            for _ in range(N_OK):
+                fetch(f"{srv.base}/query/holds?{hold_q}")
+            for _ in range(N_BAD):
+                try:
+                    fetch(f"{srv.base}/query/holds?dep=bogus&ref=0")
+                    failures.append("malformed query did not return 400")
+                except urllib.error.HTTPError as e:
+                    if e.code != 400:
+                        failures.append(
+                            f"malformed query returned {e.code} != 400")
+
+            prom = fetch(srv.base + "/metrics").decode()
+            bad = [ln for ln in prom.splitlines()
+                   if ln and not ln.startswith("#")
+                   and not SAMPLE_RE.match(ln)]
+            if bad:
+                failures.append(f"/metrics lines do not parse as "
+                                f"Prometheus text: {bad[:3]}")
+            n_ok = N_ANS + N_OK
+            got_ok = _prom_value(prom, "rdfind_serve_requests_total",
+                                 'endpoint="holds",outcome="ok"')
+            got_400 = _prom_value(prom, "rdfind_serve_requests_total",
+                                  'endpoint="holds",outcome="400"')
+            got_cnt = _prom_value(prom,
+                                  "rdfind_serve_holds_latency_us_count")
+            if got_ok != n_ok:
+                failures.append(f"requests_total ok={got_ok} != {n_ok} "
+                                f"fired (counters lost requests)")
+            if got_400 != N_BAD:
+                failures.append(f"requests_total 400={got_400} != {N_BAD} "
+                                f"malformed fired (satellite bugfix broke)")
+            if got_cnt != n_ok:
+                failures.append(f"histogram _count={got_cnt} != {n_ok} "
+                                f"ok requests (torn/lossy aggregation)")
+
+            slo = json.loads(fetch(srv.base + "/slo"))
+            v = slo.get("verdict") or {}
+            if v.get("state") != "burning" or v.get("slo") != "p99":
+                failures.append(f"planted slow path: /slo verdict "
+                                f"{v.get('state')}/{v.get('slo')} != "
+                                f"burning/p99")
+            st = json.loads(fetch(srv.base + "/status"))
+            if (st.get("slo") or {}).get("state") != "burning":
+                failures.append(f"/status slo {st.get('slo')} not burning")
+            slowlog = json.loads(fetch(srv.base + "/debug/slowlog"))
+            if "entries" not in slowlog:
+                failures.append(f"/debug/slowlog malformed: {slowlog}")
+
+            time.sleep(1.0)  # let a beat carry the burning verdict
+            rc, watch = _watch_json(obs_a)
+            if rc != 0:
+                failures.append(f"tpu_watch --status exit {rc} != 0 "
+                                f"(exit codes must be unchanged)")
+            if not watch.get("slo_burning"):
+                failures.append(f"tpu_watch --json slo_burning="
+                                f"{watch.get('slo_burning')} != true")
+        finally:
+            rc = srv.stop()
+        if rc not in (0, 128 + signal.SIGTERM):
+            failures.append(f"server A exit code {rc}")
+        dump = os.path.join(obs_a, "slowlog-host0.json")
+        if not os.path.exists(dump):
+            failures.append("SIGTERM did not dump the slow-query ring "
+                            f"({dump} missing)")
+
+        # --- 3: planted stale bundle (staleness SLO + SERVING-STALE) -------
+        srv = Server(idx_stale, obs_dir=obs_stale,
+                     env_extra={"RDFIND_SLO_STALENESS_S": "5"})
+        if srv.base is None:
+            srv.kill()
+            print("serve_obs_parity: FAIL — stale-gate server never "
+                  "announced a console URL", file=sys.stderr)
+            return 1
+        try:
+            # A chain-broken generation 1 whose data committed 60s ago:
+            # the swap must be refused and staleness must burn.
+            serving.write_index(
+                idx_stale, values, table, generation=1,
+                output_digest="stale-g1",
+                base_output_digest="not-the-served-digest",
+                extra={"bundle_commit_unix": round(time.time() - 60, 3)})
+            deadline = time.time() + 20
+            v = {}
+            while time.time() < deadline:
+                time.sleep(0.5)
+                slo = json.loads(fetch(srv.base + "/slo"))
+                v = slo.get("verdict") or {}
+                if v.get("state") == "burning":
+                    break
+            if v.get("state") != "burning" or v.get("slo") != "staleness":
+                failures.append(f"planted stale bundle: /slo verdict "
+                                f"{v.get('state')}/{v.get('slo')} != "
+                                f"burning/staleness")
+            fresh = slo.get("freshness") or {}
+            if fresh.get("generations_behind") != 1:
+                failures.append(f"freshness generations_behind="
+                                f"{fresh.get('generations_behind')} != 1")
+            time.sleep(1.0)
+            rc, watch = _watch_json(obs_stale)
+            if rc != 0:
+                failures.append(f"tpu_watch --status (stale) exit {rc}")
+            if not watch.get("slo_burning") or not watch.get(
+                    "serving_stale"):
+                failures.append(
+                    f"tpu_watch --json slo_burning="
+                    f"{watch.get('slo_burning')} serving_stale="
+                    f"{watch.get('serving_stale')} — both must be true")
+        finally:
+            rc = srv.stop()
+
+        # --- 4: obs off — byte-identical answers ---------------------------
+        srv = Server(idx_a, env_extra={"RDFIND_SERVE_OBS": "0"})
+        if srv.base is None:
+            srv.kill()
+            print("serve_obs_parity: FAIL — obs-off server never "
+                  "announced a console URL", file=sys.stderr)
+            return 1
+        try:
+            answers_off = [fetch(srv.base + u) for u in ans_urls]
+            prom_off = fetch(srv.base + "/metrics").decode()
+        finally:
+            srv.stop()
+        if answers_on != answers_off:
+            diff = sum(a != b for a, b in zip(answers_on, answers_off))
+            failures.append(f"obs on/off answers differ on {diff}/"
+                            f"{N_ANS} queries (must be byte-identical)")
+        if _prom_value(prom_off, "rdfind_serve_requests_total",
+                       'endpoint="holds",outcome="ok"') not in (None, 0.0):
+            failures.append("RDFIND_SERVE_OBS=0 still counted requests")
+
+    if failures:
+        for f in failures:
+            print(f"serve_obs_parity: {f}", file=sys.stderr)
+        return 1
+    print("serve_obs_parity: OK — live /metrics parses with exact "
+          "request/histogram counts (malformed traffic counted), planted "
+          "slow path burns the p99 SLO and planted stale bundle burns the "
+          "staleness SLO on /slo + heartbeat + tpu_watch --status, "
+          "SIGTERM dumps the slowlog, and answers are byte-identical "
+          "with observability off")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -136,6 +136,19 @@ elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/serve_parity.py; then
     exit 1
 fi
 
+echo "== serve observability parity (live admin plane: /metrics, SLOs) =="
+# The live serving process's telemetry must be exact (Prometheus scrape
+# counts == fired requests, malformed traffic counted), planted slow/stale
+# conditions must burn the NAMED SLO on /slo + heartbeat + tpu_watch, and
+# answers must be byte-identical with obs off.  VERIFY_SKIP_SERVE_OBS=1
+# opts out.
+if [ "${VERIFY_SKIP_SERVE_OBS:-0}" = "1" ]; then
+    echo "verify: serve obs parity skipped (VERIFY_SKIP_SERVE_OBS=1)"
+elif ! JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/serve_obs_parity.py; then
+    echo "verify: serve obs parity FAILED" >&2
+    exit 1
+fi
+
 if [ "${VERIFY_SKIP_BENCH:-0}" = "1" ]; then
     echo "verify: tier-1 green; bench + sentinel skipped (VERIFY_SKIP_BENCH=1)"
     exit 0

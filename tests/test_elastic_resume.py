@@ -258,7 +258,7 @@ def _scripted_vote(monkeypatch, responses):
     calls = []
     resp = [np.asarray(r, np.float64) for r in responses]
 
-    def fake_allgather(values):
+    def fake_allgather(values, site="allgather"):
         calls.append(np.asarray(values, np.float64).ravel().tolist())
         return resp.pop(0)
 

@@ -1,0 +1,405 @@
+"""The serving observability plane (obs/servestats, ISSUE 20).
+
+Covers the sharded per-request telemetry under a multi-threaded query
+storm (counts conserved exactly, scrape-time quantiles within bucket
+error of the exact percentiles, concurrent scrapes never torn), the SLO
+burn-rate engine's edge cases (clock skew, empty windows, flapping spike
+-> warn not burning, sustained burn -> burning by name, staleness as a
+level), the slow-query ring + its dump, the console's non-200 counting
+(the satellite bugfix: refused/malformed traffic must land in counters),
+and the obs on/off bit-identical answer contract through IndexService.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from rdfind_tpu import conditions as cc
+from rdfind_tpu.data import NO_VALUE, CindTable
+from rdfind_tpu.obs import console, metrics, servestats
+from rdfind_tpu.runtime import serving
+
+CODES = cc.ALL_VALID_CAPTURE_CODES[:3]
+
+
+def _workload(n_deps=40, refs_per_dep=5, seed=7):
+    """(values, table, truth) — the test_serving.py synthetic CIND shape."""
+    rng = np.random.default_rng(seed)
+    dep_vals = [f"http://ex.org/dep/{i:05d}" for i in range(n_deps)]
+    ref_vals = [f"http://ex.org/ref/{i:05d}"
+                for i in range(n_deps * refs_per_dep)]
+    values = sorted(dep_vals + ref_vals)
+    vid = {v: i for i, v in enumerate(values)}
+    rows, truth = [], {}
+    for d in range(n_deps):
+        sup = int(rng.integers(2, 500))
+        dep = (CODES[d % len(CODES)], vid[dep_vals[d]], NO_VALUE)
+        for r in range(refs_per_dep):
+            rv = ref_vals[d * refs_per_dep + r]
+            ref = (CODES[(d + r) % len(CODES)], vid[rv], NO_VALUE)
+            rows.append((*dep, *ref, sup))
+            truth[(dep, ref)] = sup
+    return values, CindTable.from_rows(rows), truth
+
+
+def _write(tmp_path, values, table, generation=0, output_digest="d0"):
+    return serving.write_index(str(tmp_path), values, table,
+                               generation=generation,
+                               output_digest=output_digest)
+
+
+@pytest.fixture(autouse=True)
+def _clean_stats(monkeypatch):
+    """Every test starts from empty shards with default knobs."""
+    for k in ("RDFIND_SERVE_OBS", "RDFIND_SERVE_OBS_SLOW_US",
+              "RDFIND_SERVE_OBS_SLOWLOG", "RDFIND_SLO_P99_US",
+              "RDFIND_SLO_ERROR_FRAC", "RDFIND_SLO_STALENESS_S",
+              "RDFIND_SLO_FAST_S", "RDFIND_SLO_SLOW_S"):
+        monkeypatch.delenv(k, raising=False)
+    servestats.reset()
+    servestats.configure()
+    yield
+    servestats.reset()
+    servestats.configure()
+
+
+# ---------------------------------------------------------------------------
+# Sharded aggregation under a storm.
+# ---------------------------------------------------------------------------
+
+
+def test_storm_counts_conserved_and_quantiles_bounded():
+    n_threads, per_thread = 8, 4000
+    rng = np.random.default_rng(11)
+    # Per-thread latency samples, drawn once so the exact percentiles are
+    # computable after the fact.
+    samples = rng.lognormal(mean=3.0, sigma=1.0, size=(n_threads,
+                                                       per_thread)) + 1.0
+
+    def work(i):
+        rec = servestats.record
+        for us in samples[i]:
+            rec("holds", "ok", us=float(us), generation=3)
+        for _ in range(17):
+            rec("topk", "400")
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    agg = servestats.aggregate()
+    total = n_threads * per_thread
+    assert agg["requests"]["holds"]["ok"] == total
+    assert agg["requests"]["topk"]["400"] == n_threads * 17
+    lat = agg["latency_us"]["holds"]
+    assert lat["count"] == total
+    assert lat["min"] == pytest.approx(float(samples.min()), abs=1e-3)
+    assert lat["max"] == pytest.approx(float(samples.max()), abs=1e-3)
+    assert lat["sum"] == pytest.approx(float(samples.sum()), rel=1e-6,
+                                       abs=1e-3)
+    # The log-bucketed quantiles must land within one bucket's relative
+    # error (base 2^0.25 => midpoint is within ~13% of any true value in
+    # the bucket) of the exact percentiles.
+    flat = samples.ravel()
+    for q in (50, 95, 99):
+        exact = float(np.percentile(flat, q))
+        got = lat[f"p{q}"]
+        assert abs(got - exact) / exact < 0.2, (q, got, exact)
+
+
+def test_concurrent_scrape_never_torn():
+    """aggregate() racing a storm: every scrape is internally consistent
+    (histogram count == sum of its buckets, counters monotonic)."""
+    stop = threading.Event()
+
+    def storm():
+        rec = servestats.record
+        while not stop.is_set():
+            rec("holds", "ok", us=42.0)
+
+    writers = [threading.Thread(target=storm) for _ in range(4)]
+    for t in writers:
+        t.start()
+    try:
+        last = 0
+        for _ in range(200):
+            agg = servestats.aggregate()
+            n = agg["requests"].get("holds", {}).get("ok", 0)
+            assert n >= last, "counter went backwards across scrapes"
+            last = n
+            lat = agg["latency_us"].get("holds")
+            if lat is not None:
+                # count derives from the bucket sums, so the quantile
+                # walk can never see a total it doesn't have.
+                assert lat["count"] <= n
+    finally:
+        stop.set()
+        for t in writers:
+            t.join()
+    final = servestats.aggregate()
+    assert final["requests"]["holds"]["ok"] == \
+        final["latency_us"]["holds"]["count"]
+
+
+def test_prometheus_text_shape_and_counts():
+    import re
+    for _ in range(5):
+        servestats.record("holds", "ok", us=100.0)
+    servestats.record("holds", "503")
+    txt = servestats.prometheus_text()
+    sample = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$")
+    for line in txt.strip().splitlines():
+        assert line.startswith("#") or sample.match(line), line
+    assert 'rdfind_serve_requests_total{endpoint="holds",outcome="ok"} 5' \
+        in txt
+    assert 'rdfind_serve_requests_total{endpoint="holds",outcome="503"} 1' \
+        in txt
+    assert "rdfind_serve_holds_latency_us_count 5" in txt
+
+
+def test_disabled_records_nothing():
+    os.environ["RDFIND_SERVE_OBS"] = "0"
+    assert servestats.configure() is False
+    servestats.record("holds", "ok", us=5.0)
+    assert servestats.aggregate()["total"] == 0
+    del os.environ["RDFIND_SERVE_OBS"]
+    assert servestats.configure() is True
+
+
+# ---------------------------------------------------------------------------
+# Slow-query ring.
+# ---------------------------------------------------------------------------
+
+
+def test_slowlog_ring_capture_and_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("RDFIND_SERVE_OBS_SLOW_US", "1000")
+    monkeypatch.setenv("RDFIND_SERVE_OBS_SLOWLOG", "3")
+    servestats.configure()
+    servestats.record("holds", "ok", us=10.0)  # below threshold: not logged
+    for i in range(5):
+        servestats.record("referenced", "ok", us=2000.0 + i,
+                          generation=7, args=(f"dep{i}", 16))
+    ring = servestats.slowlog()
+    assert len(ring) == 3  # bounded: only the newest 3 survive
+    assert [e["us"] for e in ring] == [2002.0, 2003.0, 2004.0]
+    assert ring[-1]["endpoint"] == "referenced"
+    assert ring[-1]["generation"] == 7
+    path = servestats.dump_slowlog(str(tmp_path), reason="test")
+    payload = json.load(open(path))
+    assert payload["reason"] == "test" and payload["n_entries"] == 3
+    assert payload["entries"][-1]["us"] == 2004.0
+
+
+# ---------------------------------------------------------------------------
+# SLO engine edges.
+# ---------------------------------------------------------------------------
+
+
+def _burn(n_ok, n_err, us=50.0):
+    for _ in range(n_ok):
+        servestats.record("holds", "ok", us=us)
+    for _ in range(n_err):
+        servestats.record("holds", "503")
+
+
+def test_slo_empty_windows_yield_ok():
+    eng = servestats.SloEngine(p99_us=100.0, error_frac=0.01,
+                               fast_s=60, slow_s=600)
+    v = eng.evaluate(now=1000.0)  # no traffic at all
+    assert v == {**v, "state": "ok", "slo": None}
+
+
+def test_slo_clock_skew_never_crashes_or_lies():
+    eng = servestats.SloEngine(error_frac=0.01, fast_s=60, slow_s=600)
+    _burn(10, 0)
+    eng.observe_snapshot(now=2000.0)
+    # Clock jumps backwards: the stale-future snapshot must not produce a
+    # negative window or a verdict computed against it.
+    v = eng.evaluate(now=1000.0)
+    assert v["state"] == "ok"
+    assert all(s[0] <= 2000.0 for s in eng.history)
+    # Clock recovers: evaluation proceeds normally.
+    _burn(0, 50)
+    v = eng.evaluate(now=2100.0)
+    assert v["state"] in ("warn", "burning")
+
+
+def test_slo_flapping_spike_warns_not_burns():
+    """A brief error spike trips the fast window only -> warn; the page
+    (burning) needs BOTH windows over target."""
+    eng = servestats.SloEngine(error_frac=0.05, fast_s=60, slow_s=600)
+    # 10 minutes of clean traffic establishes the slow window's baseline.
+    t = 1000.0
+    for i in range(20):
+        _burn(50, 0)
+        eng.observe_snapshot(now=t + i * 30)
+    now = t + 600
+    # A spike inside the last fast window: 30 errors over 40 requests.
+    _burn(10, 30)
+    v = eng.evaluate(now=now)
+    assert v["state"] == "warn" and v["slo"] == "error_frac", v
+    d = v["detail"]
+    assert d["fast_frac"] > 0.05 >= d["slow_frac"]
+
+
+def test_slo_sustained_burn_is_named():
+    eng = servestats.SloEngine(error_frac=0.05, fast_s=60, slow_s=600)
+    t = 1000.0
+    for i in range(20):
+        _burn(10, 10)  # 50% errors, continuously
+        eng.observe_snapshot(now=t + i * 30)
+    v = eng.evaluate(now=t + 600)
+    assert v["state"] == "burning" and v["slo"] == "error_frac"
+
+
+def test_slo_p99_burn_by_name():
+    eng = servestats.SloEngine(p99_us=100.0, fast_s=60, slow_s=600)
+    eng.observe_snapshot(now=1000.0)
+    _burn(50, 0, us=5000.0)
+    v = eng.evaluate(now=1005.0)
+    assert v["state"] == "burning" and v["slo"] == "p99"
+    assert v["detail"]["fast_p99_us"] > 100.0
+
+
+def test_slo_staleness_is_level_based():
+    eng = servestats.SloEngine(staleness_s=10.0)
+    burn = {"generations_behind": 1, "staleness_s": 60.0,
+            "index_age_s": 60.0}
+    v = eng.evaluate(freshness=burn, now=1000.0)
+    assert v["state"] == "burning" and v["slo"] == "staleness"
+    # Behind but young -> warn, not burning.
+    young = {"generations_behind": 1, "staleness_s": 2.0,
+             "index_age_s": 2.0}
+    v = eng.evaluate(freshness=young, now=1001.0)
+    assert v["state"] == "warn" and v["slo"] == "staleness"
+    # Caught up -> the historical swap lag alone never burns.
+    caught = {"generations_behind": 0, "staleness_s": 60.0,
+              "index_age_s": 60.0}
+    v = eng.evaluate(freshness=caught, now=1002.0)
+    assert v["state"] == "warn"
+    ok = {"generations_behind": 0, "staleness_s": 1.0, "index_age_s": 1.0}
+    v = eng.evaluate(freshness=ok, now=1003.0)
+    assert v["state"] == "ok" and v["slo"] is None
+
+
+def test_slo_disabled_thresholds_never_fire():
+    eng = servestats.SloEngine(p99_us=0.0, error_frac=0.0,
+                               staleness_s=0.0)
+    _burn(5, 50, us=1e6)
+    v = eng.evaluate(freshness={"generations_behind": 3,
+                                "staleness_s": 1e6}, now=1000.0)
+    assert v["state"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# Console counting (the non-200 satellite bugfix) + freshness wiring.
+# ---------------------------------------------------------------------------
+
+
+def test_console_counts_non_200(tmp_path):
+    reg = metrics.Registry()
+    stash = metrics._REGISTRY
+    metrics._REGISTRY = reg
+    try:
+        console.set_query_service(None)
+        payload, code = console.query_holds_payload("dep=0&ref=0")
+        assert code == 503
+        svc = serving.IndexService(str(tmp_path))  # no index on disk
+        console.set_query_service(svc)
+        payload, code = console.query_holds_payload("dep=bogus&ref=0")
+        assert code == 400
+        payload, code = console.query_holds_payload("dep=0&ref=0")
+        assert code == 503 and payload["error"] == "no index loaded"
+        snap = reg.snapshot()
+        assert snap["serve_http_503"] == 2
+        assert snap["serve_http_400"] == 1
+        assert snap["serve_refused"] == 1
+        agg = servestats.aggregate()
+        assert agg["requests"]["holds"]["503"] == 1
+        assert agg["requests"]["holds"]["400"] == 1
+        assert agg["requests"]["holds"]["refused"] == 1
+        svc.close()
+    finally:
+        metrics._REGISTRY = stash
+        console.set_query_service(None)
+
+
+def test_service_freshness_and_status(tmp_path):
+    values, table, truth = _workload()
+    _write(tmp_path, values, table)
+    svc = serving.IndexService(str(tmp_path))
+    assert svc.poll()["action"] == "swapped"
+    fresh = svc.freshness()
+    assert fresh["generations_behind"] == 0
+    assert fresh["index_age_s"] is not None and fresh["index_age_s"] < 60
+    assert fresh["staleness_s"] is not None
+    st = svc.status()
+    assert st["freshness"]["generations_behind"] == 0
+    # A newer chain-broken bundle on disk: behind grows, staleness tracks
+    # the PENDING bundle's commit stamp.
+    serving.write_index(str(tmp_path), values, table, generation=1,
+                        output_digest="d1", base_output_digest="bogus",
+                        extra={"bundle_commit_unix": 1.0})
+    assert svc.poll()["action"] == "refused"
+    fresh = svc.freshness()
+    assert fresh["generations_behind"] == 1
+    assert fresh["staleness_s"] > 1e6  # epoch-old pending commit
+    svc.close()
+
+
+def test_answers_bit_identical_obs_on_off(tmp_path):
+    values, table, truth = _workload()
+    _write(tmp_path, values, table)
+    svc = serving.IndexService(str(tmp_path))
+    assert svc.poll()["action"] == "swapped"
+    qs = []
+    for (dep, ref) in list(truth)[:20]:
+        qs.append(((dep[0], values[dep[1]], None),
+                   (ref[0], values[ref[1]], None)))
+
+    def run_all():
+        return ([svc.query_holds(d, r) for d, r in qs]
+                + [svc.query_referenced(qs[0][0], limit=8)]
+                + [svc.query_topk(5)])
+
+    on = run_all()
+    assert servestats.aggregate()["requests"]["holds"]["ok"] == len(qs)
+    os.environ["RDFIND_SERVE_OBS"] = "0"
+    servestats.reset()
+    servestats.configure()
+    try:
+        off = run_all()
+        assert servestats.aggregate()["total"] == 0
+    finally:
+        del os.environ["RDFIND_SERVE_OBS"]
+        servestats.configure()
+    assert json.dumps(on, sort_keys=True, default=str) == \
+        json.dumps(off, sort_keys=True, default=str)
+    svc.close()
+
+
+def test_index_meta_carries_commit_and_batch(tmp_path):
+    values, table, _ = _workload()
+    serving.write_index(
+        str(tmp_path), values, table, generation=0, output_digest="d0",
+        extra={"bundle_commit_unix": 123.456,
+               "batch": {"inserts": 9, "deletes": 2}})
+    meta = serving.peek_meta(serving.index_path(str(tmp_path)))
+    assert meta["bundle_commit_unix"] == 123.456
+    assert meta["batch"] == {"inserts": 9, "deletes": 2}
+    r = serving.IndexReader(serving.index_path(str(tmp_path)))
+    assert r.bundle_commit_unix == 123.456
+    assert r.batch == {"inserts": 9, "deletes": 2}
+    r.close()
+    # Without extra the commit stamp defaults to the write time.
+    d2 = tmp_path / "plain"
+    serving.write_index(str(d2), values, table, generation=0,
+                        output_digest="d0")
+    meta = serving.peek_meta(serving.index_path(str(d2)))
+    assert meta["bundle_commit_unix"] == meta["created_unix"]

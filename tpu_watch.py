@@ -248,6 +248,22 @@ def _serving_stale_hosts(hosts: dict) -> dict:
     return out
 
 
+def _slo_burning_hosts(hosts: dict) -> dict:
+    """Hosts running a serving process whose SLO engine reports a
+    sustained burn (both burn-rate windows over target — obs/servestats).
+    SLO-BURNING is distinct from SERVING-STALE: staleness says the data
+    is out of date; a burn says the service itself (latency, errors, or
+    freshness) is violating its target right now."""
+    out = {}
+    for h, b in hosts.items():
+        if b.get("mode") != "serve" or b.get("final"):
+            continue
+        slo = b.get("slo")
+        if isinstance(slo, dict) and slo.get("state") == "burning":
+            out[h] = slo
+    return out
+
+
 def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
     """The wedged-vs-slow verdict over a run's obs directory (exit codes:
     0 alive/done, 1 wedged, 2 no heartbeat at all, 3 CORRUPT — an
@@ -264,6 +280,7 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
     recovering = _recovering_hosts(hosts)
     corrupt = _corrupt_hosts(hosts)
     serving_stale = _serving_stale_hosts(hosts)
+    slo_burning = _slo_burning_hosts(hosts)
     recs = _flightrec_summaries(obs_dir)
     if as_json:
         print(json.dumps({"dir": obs_dir, "state": state,
@@ -271,6 +288,7 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
                           "recovering": bool(recovering),
                           "corrupt": bool(corrupt),
                           "serving_stale": bool(serving_stale),
+                          "slo_burning": bool(slo_burning),
                           "stale_s": stale_s, "age_s": verdict["age_s"],
                           "hosts": hosts, "flightrec": recs},
                          sort_keys=True, default=str))
@@ -331,6 +349,18 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
                   f"dir committed generation {sv['bundle_generation']} "
                   f"but the server still answers from "
                   f"{sv['generation']}{why}")
+        if b.get("mode") == "serve" and not b.get("final") \
+                and "index_age_s" in b:
+            print(f"status[{obs_dir}] host {h}: freshness — index age "
+                  f"{b.get('index_age_s')}s, staleness "
+                  f"{b.get('staleness_s')}s, "
+                  f"{b.get('generations_behind')} generation(s) behind")
+        burn = slo_burning.get(h)
+        if burn is not None:
+            print(f"status[{obs_dir}] host {h}: SLO-BURNING — "
+                  f"{burn.get('slo')} SLO over target on both burn-rate "
+                  f"windows (the service is violating its target now, "
+                  f"not momentarily)")
     # Surface the wedged host's flight recorder when one was dumped: the
     # ring of events leading into the stall, captured even with the jsonl
     # tracer off.
@@ -356,6 +386,11 @@ def report_status(obs_dir: str, stale_s: float, as_json: bool = False) -> int:
         tail = (" (degrading: cap-exhaustion forecast active on host(s) "
                 f"{sorted(degrading)} — alive, but the degradation ladder "
                 "is imminent)")
+    elif slo_burning:
+        names = sorted({v.get("slo") for v in slo_burning.values()})
+        tail = (f" (SLO-BURNING: host(s) {sorted(slo_burning)} over "
+                f"target on {', '.join(map(str, names))} — sustained "
+                "burn, not a spike)")
     elif serving_stale:
         tail = (" (SERVING-STALE: host(s) "
                 f"{sorted(serving_stale)} answer from an older generation "
@@ -401,6 +436,19 @@ def report_console(url: str, as_json: bool = False) -> int:
                   f"{si.get('bundle_generation')}), {si.get('n_cinds')} "
                   f"CINDs, {si.get('swaps')} swap(s), "
                   f"{si.get('refusals')} refusal(s)")
+            fresh = si.get("freshness")
+            if isinstance(fresh, dict):
+                print(f"console[{base}]: freshness — index age "
+                      f"{fresh.get('index_age_s')}s, staleness "
+                      f"{fresh.get('staleness_s')}s, "
+                      f"{fresh.get('generations_behind')} generation(s) "
+                      f"behind")
+            slo = status.get("slo")
+            if isinstance(slo, dict):
+                which = f" ({slo.get('slo')})" if slo.get("slo") else ""
+                label = str(slo.get("state", "ok")).upper() \
+                    if slo.get("state") != "ok" else "ok"
+                print(f"console[{base}]: SLO {label}{which}")
             if si.get("stale"):
                 why = (f"; last candidate: {si.get('pending')}"
                        if si.get("pending") else "")
